@@ -1,0 +1,60 @@
+"""raft_tpu — TPU-native reusable ML/vector-search primitives.
+
+A from-scratch, TPU-first framework with the capabilities of RAPIDS RAFT
+(reference: /root/reference, dwwcqu/raft @ 23.08): pairwise distances, top-k
+selection, random data generation, clustering, ANN indexes (brute-force,
+IVF-Flat, IVF-PQ, CAGRA), sparse/graph solvers, statistics, and a multi-chip
+communicator over ICI/DCN — built on JAX/XLA, ``shard_map`` and Pallas rather
+than CUDA. See SURVEY.md for the layer map this implements.
+
+Subpackages (lazily imported):
+  core       resource handle, errors, logging, serialization   (ref: raft/core)
+  comms      collectives veneer over shard_map                 (ref: raft/comms)
+  distance   pairwise distances, fused 1-NN, gram kernels      (ref: raft/distance)
+  linalg     dense BLAS/solvers/reductions                     (ref: raft/linalg)
+  matrix     matrix ops + select_k                             (ref: raft/matrix)
+  random     RNG + synthetic data generators                   (ref: raft/random)
+  stats      moments + clustering/regression metrics           (ref: raft/stats)
+  cluster    kmeans (+balanced), single-linkage                (ref: raft/cluster)
+  neighbors  ANN indexes                                       (ref: raft/neighbors)
+  sparse     sparse containers/linalg/distances                (ref: raft/sparse)
+  solver     lanczos, MST, LAP                                 (ref: raft/solver, sparse/solver)
+  spectral   spectral clustering/partitioning                  (ref: raft/spectral)
+  label      label utilities                                   (ref: raft/label)
+  ops        Pallas TPU kernels backing the hot paths
+  parallel   distributed (sharded) algorithm drivers           (ref: raft::comms consumers)
+"""
+
+import importlib
+
+from .version import __version__
+from .core import Resources, DeviceResources, default_resources
+
+_SUBMODULES = {
+    "core",
+    "comms",
+    "distance",
+    "linalg",
+    "matrix",
+    "random",
+    "stats",
+    "cluster",
+    "neighbors",
+    "sparse",
+    "solver",
+    "spectral",
+    "label",
+    "ops",
+    "parallel",
+    "utils",
+}
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | _SUBMODULES)

@@ -1,0 +1,40 @@
+"""raft_tpu.core — the runtime layer.
+
+TPU-native re-imagining of the reference's L1 core
+(cpp/include/raft/core/): resource handle, errors, logging, profiler ranges,
+serialization. mdspan/mdarray collapse into ``jax.Array`` (SURVEY.md §7-2);
+interruptibility maps to Python's native KeyboardInterrupt + XLA's execution
+model rather than a bespoke cancellation token.
+"""
+
+from .errors import RaftError, expects, fail
+from .logger import logger, set_level
+from .resources import DeviceResources, Resources, default_resources, set_default_resources
+from .serialize import (
+    deserialize_json,
+    deserialize_mdspan,
+    deserialize_scalar,
+    serialize_json,
+    serialize_mdspan,
+    serialize_scalar,
+)
+from . import tracing
+
+__all__ = [
+    "RaftError",
+    "expects",
+    "fail",
+    "logger",
+    "set_level",
+    "Resources",
+    "DeviceResources",
+    "default_resources",
+    "set_default_resources",
+    "serialize_mdspan",
+    "deserialize_mdspan",
+    "serialize_scalar",
+    "deserialize_scalar",
+    "serialize_json",
+    "deserialize_json",
+    "tracing",
+]

@@ -1,0 +1,31 @@
+"""Error handling for raft_tpu.
+
+TPU-native equivalent of the reference's exception/assert layer
+(cpp/include/raft/core/error.hpp: RAFT_EXPECTS at :168, RAFT_FAIL at :184).
+Host-side validation raises :class:`RaftError`; traced (in-jit) value checks
+should use `jax.experimental.checkify` instead, since Python exceptions cannot
+depend on traced values.
+"""
+
+from __future__ import annotations
+
+__all__ = ["RaftError", "expects", "fail"]
+
+
+class RaftError(RuntimeError):
+    """Base exception for raft_tpu (reference: raft::exception, core/error.hpp:98)."""
+
+
+def expects(cond: bool, fmt: str, *args) -> None:
+    """Host-side precondition check (reference: RAFT_EXPECTS, core/error.hpp:168).
+
+    Raises :class:`RaftError` if ``cond`` is falsy. ``fmt`` may be a printf-style
+    format consumed with ``*args`` for message-construction laziness.
+    """
+    if not cond:
+        raise RaftError(fmt % args if args else fmt)
+
+
+def fail(fmt: str, *args) -> None:
+    """Unconditional failure (reference: RAFT_FAIL, core/error.hpp:184)."""
+    raise RaftError(fmt % args if args else fmt)

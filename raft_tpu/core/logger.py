@@ -1,0 +1,48 @@
+"""Logging for raft_tpu.
+
+TPU-native equivalent of the reference's spdlog wrapper
+(cpp/include/raft/core/logger-ext.hpp:34, logger-macros.hpp:44-95). The
+reference supports runtime level/pattern control and a callback sink so Python
+can capture logs; here the standard :mod:`logging` module provides all of that
+natively, so this module only pins down the logger name, the level vocabulary
+(including the TRACE level spdlog has and stdlib lacks) and small helpers.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = [
+    "logger",
+    "set_level",
+    "OFF",
+    "CRITICAL",
+    "ERROR",
+    "WARN",
+    "INFO",
+    "DEBUG",
+    "TRACE",
+]
+
+# Level vocabulary mirrors the reference's RAFT_LEVEL_* (logger-macros.hpp).
+OFF = logging.CRITICAL + 10
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARN = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+TRACE = logging.DEBUG - 5
+
+logging.addLevelName(TRACE, "TRACE")
+
+logger = logging.getLogger("raft_tpu")
+logger.addHandler(logging.NullHandler())
+
+
+def set_level(level: int) -> None:
+    """Set the global raft_tpu log level (reference: logger::set_level)."""
+    logger.setLevel(level)
+
+
+def trace(msg: str, *args) -> None:
+    logger.log(TRACE, msg, *args)

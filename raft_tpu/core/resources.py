@@ -1,0 +1,120 @@
+"""The raft_tpu resource handle.
+
+TPU-native redesign of the reference's resource registry + device handle
+(cpp/include/raft/core/resources.hpp:47, device_resources.hpp:60). On CUDA the
+handle carries streams, a stream pool, cuBLAS/cuSOLVER/cuSPARSE handles, an RMM
+workspace allocator and an optional communicator. Under JAX/XLA almost all of
+that dissolves: XLA owns streams and allocation, vendor libraries are the
+compiler, and kernels are fused automatically. What meaningfully survives:
+
+- the **device mesh** (multi-chip topology) — the TPU analogue of the handle's
+  comms + sub-comms (device_resources.hpp:204-219),
+- a **workspace budget** used by memory-aware batching heuristics (the analogue
+  of rmm workspace_resource; e.g. brute-force kNN tile sizing, reference
+  neighbors/detail/knn_brute_force.cuh:78),
+- a default **device** for single-chip placement,
+- ``sync()`` — the analogue of ``handle.sync_stream()``.
+
+Every public raft_tpu API takes an optional ``res: Resources`` first argument
+(defaulting to a process-global handle) to preserve the reference's calling
+convention without burdening simple use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["Resources", "DeviceResources", "default_resources", "set_default_resources"]
+
+
+@dataclasses.dataclass
+class Resources:
+    """Resource handle (reference: raft::device_resources, core/device_resources.hpp:60).
+
+    Attributes:
+      device: default device for placement; ``None`` = JAX default.
+      mesh: ``jax.sharding.Mesh`` for distributed algorithms; ``None`` = single
+        device. Plays the role of the handle's communicator slot
+        (core/resource/comms.hpp) — distributed entry points read it.
+      workspace_bytes: soft budget for temporary distance/score matrices, used
+        by batching heuristics (reference: workspace_resource +
+        chooseTileSize, knn_brute_force.cuh:78).
+    """
+
+    device: Optional[Any] = None
+    mesh: Optional[jax.sharding.Mesh] = None
+    workspace_bytes: int = 2 << 30
+    # Free-form registry for user extensions — the residue of the reference's
+    # type-keyed resource factory map (core/resources.hpp:91-124).
+    _registry: dict = dataclasses.field(default_factory=dict, repr=False)
+
+    # -- registry (reference: add_resource_factory / get_resource) -----------
+    def set_resource(self, key: str, value: Any) -> None:
+        self._registry[key] = value
+
+    def get_resource(self, key: str, default: Any = None) -> Any:
+        return self._registry.get(key, default)
+
+    def has_resource(self, key: str) -> bool:
+        return key in self._registry
+
+    # -- comms (reference: device_resources::get_comms/set_comms) ------------
+    def set_comms(self, comms: Any) -> None:
+        self._registry["comms"] = comms
+
+    def get_comms(self) -> Any:
+        from .errors import expects
+
+        expects("comms" in self._registry, "communicator was not initialized on this handle")
+        return self._registry["comms"]
+
+    @property
+    def comms_initialized(self) -> bool:
+        return "comms" in self._registry
+
+    # -- placement ------------------------------------------------------------
+    def put(self, x):
+        """Place an array on this handle's device (host→device staging; the
+        analogue of make_temporary_device_buffer, core/temporary_device_buffer.hpp)."""
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return jax.device_put(x)
+
+    def sync(self, *arrays) -> None:
+        """Block until the given arrays are ready (reference: handle.sync_stream()).
+
+        Pass the arrays whose computation you want to wait on. With no
+        arguments this only drains ordered side effects (``jax.effects_barrier``)
+        — it does NOT wait for pure computations, so timing code must pass the
+        output arrays explicitly.
+        """
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            jax.effects_barrier()
+
+    @property
+    def device_count(self) -> int:
+        return self.mesh.size if self.mesh is not None else 1
+
+
+# Legacy alias, mirroring raft::handle_t (core/handle.hpp).
+DeviceResources = Resources
+
+_default: Optional[Resources] = None
+
+
+def default_resources() -> Resources:
+    """Process-global default handle, created lazily."""
+    global _default
+    if _default is None:
+        _default = Resources()
+    return _default
+
+
+def set_default_resources(res: Resources) -> None:
+    global _default
+    _default = res

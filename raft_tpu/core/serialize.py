@@ -1,0 +1,83 @@
+"""Array and index-structure serialization.
+
+TPU-native equivalent of the reference's numpy-format mdspan serialization
+(cpp/include/raft/core/serialize.hpp, core/detail/mdspan_numpy_serializer.hpp)
+and the scalar serialize helpers used by index serializers
+(neighbors/ivf_pq_serialize.cuh:52-110). The on-disk vocabulary is identical —
+NumPy ``.npy`` streams — so artifacts are interoperable with numpy tooling.
+Index classes serialize as a sequence of scalars + ``.npy`` blocks in one file.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any, BinaryIO
+
+import jax
+import numpy as np
+
+__all__ = [
+    "serialize_mdspan",
+    "deserialize_mdspan",
+    "serialize_scalar",
+    "deserialize_scalar",
+    "serialize_json",
+    "deserialize_json",
+]
+
+
+def serialize_mdspan(fp: BinaryIO, arr) -> None:
+    """Write an array as a .npy stream (reference: serialize_mdspan, core/serialize.hpp)."""
+    np.save(fp, np.asarray(jax.device_get(arr)), allow_pickle=False)
+
+
+def deserialize_mdspan(fp: BinaryIO, device=None):
+    """Read a .npy stream back; returns a host numpy array (caller device_puts)."""
+    host = np.load(fp, allow_pickle=False)
+    return host if device is None else jax.device_put(host, device)
+
+
+def serialize_scalar(fp: BinaryIO, value) -> None:
+    """Write one scalar (reference: serialize_scalar used across *_serialize.cuh).
+
+    Accepts Python and numpy scalar types (np.int32 shape fields etc. are the
+    common case when writing array metadata).
+    """
+    if isinstance(value, (bool, np.bool_)):
+        fp.write(b"b" + struct.pack("<?", bool(value)))
+    elif isinstance(value, (int, np.integer)):
+        fp.write(b"i" + struct.pack("<q", int(value)))
+    elif isinstance(value, (float, np.floating)):
+        fp.write(b"f" + struct.pack("<d", float(value)))
+    elif isinstance(value, str):
+        raw = value.encode()
+        fp.write(b"s" + struct.pack("<i", len(raw)) + raw)
+    else:
+        raise TypeError(f"unsupported scalar type {type(value)}")
+
+
+def deserialize_scalar(fp: BinaryIO):
+    tag = fp.read(1)
+    if tag == b"b":
+        return struct.unpack("<?", fp.read(1))[0]
+    if tag == b"i":
+        return struct.unpack("<q", fp.read(8))[0]
+    if tag == b"f":
+        return struct.unpack("<d", fp.read(8))[0]
+    if tag == b"s":
+        (n,) = struct.unpack("<i", fp.read(4))
+        return fp.read(n).decode()
+    raise ValueError(f"bad scalar tag {tag!r}")
+
+
+def serialize_json(fp: BinaryIO, obj: Any) -> None:
+    """Write a small JSON header (used for params dataclasses in index files)."""
+    raw = json.dumps(obj).encode()
+    fp.write(struct.pack("<i", len(raw)) + raw)
+
+
+def deserialize_json(fp: BinaryIO) -> Any:
+    (n,) = struct.unpack("<i", fp.read(4))
+    return json.loads(fp.read(n).decode())

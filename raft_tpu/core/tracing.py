@@ -1,0 +1,66 @@
+"""Profiler range annotations.
+
+TPU-native equivalent of the reference's NVTX RAII ranges
+(cpp/include/raft/core/nvtx.hpp:95 push_range / common::nvtx::range). On TPU
+the profiler story is xprof/Perfetto via :mod:`jax.profiler`; a
+``TraceAnnotation`` shows up on the trace timeline exactly where an NVTX range
+would in Nsight. Like the reference (compile-gated by RAFT_NVTX), annotation is
+zero-cost when disabled — here gated by a module flag rather than a rebuild.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+# ``range`` (the reference's name) is intentionally NOT in __all__ so that a
+# star-import cannot shadow the builtin; use ``tracing.range`` or ``push_range``.
+__all__ = ["push_range", "annotate", "enable", "disable"]
+
+_enabled = True
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+@contextlib.contextmanager
+def range(name: str, *args):
+    """RAII-style profiler range (reference: common::nvtx::range, nvtx.hpp:139).
+
+    printf-style ``args`` are interpolated into ``name`` lazily, mirroring the
+    reference's format-string labels.
+    """
+    if not _enabled:
+        yield
+        return
+    label = name % args if args else name
+    with jax.profiler.TraceAnnotation(label):
+        yield
+
+
+push_range = range  # non-shadowing alias
+
+
+def annotate(name: str | None = None):
+    """Decorator form: annotate a whole function as a profiler range."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with range(label):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco

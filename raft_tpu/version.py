@@ -1,0 +1,7 @@
+"""Version of raft_tpu.
+
+Mirrors the reference's RAFT_VERSION 23.08 (cpp/CMakeLists.txt:14) but versions
+independently: this is a from-scratch TPU-native framework, not a port.
+"""
+
+__version__ = "0.1.0"

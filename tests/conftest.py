@@ -1,0 +1,55 @@
+"""Test configuration.
+
+Mirrors the reference's test topology (SURVEY.md §4): numerical tests run on
+CPU with an 8-device virtual platform so multi-chip sharding is exercised
+without TPU hardware — the analogue of the reference's LocalCUDACluster-based
+comms tests (python/raft-dask/raft_dask/test/test_comms.py) and its
+per-namespace gtest binaries. Environment variables must be set before the
+first jax import.
+"""
+
+import os
+
+import re
+
+_flags = os.environ.get("XLA_FLAGS", "")
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Force CPU: the ambient environment pins JAX to the single-chip TPU tunnel;
+# tests want 8 virtual devices. jax is already imported by the interpreter's
+# sitecustomize, so the env var route is too late — use the config API, which
+# works any time before backend initialization.
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """An 8-device 1-D mesh over the virtual CPU platform."""
+    from jax.sharding import Mesh
+
+    assert len(devices) >= 8, "conftest must force 8 host devices"
+    return Mesh(np.array(devices[:8]), ("data",))
+
+
+@pytest.fixture
+def res():
+    from raft_tpu.core import Resources
+
+    return Resources()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,95 @@
+"""Core layer tests (reference analogue: cpp/test/core/*.cu, CORE_TEST)."""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    RaftError,
+    Resources,
+    default_resources,
+    deserialize_mdspan,
+    deserialize_scalar,
+    expects,
+    fail,
+    serialize_mdspan,
+    serialize_scalar,
+)
+
+
+class TestErrors:
+    def test_expects_pass(self):
+        expects(True, "should not raise")
+
+    def test_expects_fail(self):
+        with pytest.raises(RaftError, match="n must be 3"):
+            expects(False, "n must be %d", 3)
+
+    def test_fail(self):
+        with pytest.raises(RaftError):
+            fail("boom")
+
+
+class TestResources:
+    def test_default_singleton(self):
+        assert default_resources() is default_resources()
+
+    def test_registry(self):
+        r = Resources()
+        assert not r.has_resource("x")
+        r.set_resource("x", 42)
+        assert r.get_resource("x") == 42
+
+    def test_comms_uninitialized(self):
+        r = Resources()
+        assert not r.comms_initialized
+        with pytest.raises(RaftError):
+            r.get_comms()
+
+    def test_put_and_sync(self):
+        r = Resources()
+        x = r.put(np.arange(8, dtype=np.float32))
+        r.sync(x)
+        np.testing.assert_array_equal(np.asarray(x), np.arange(8))
+
+    def test_device_count_no_mesh(self):
+        assert Resources().device_count == 1
+
+
+class TestSerialize:
+    def test_mdspan_roundtrip(self):
+        buf = io.BytesIO()
+        a = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+        serialize_mdspan(buf, a)
+        buf.seek(0)
+        b = deserialize_mdspan(buf)
+        np.testing.assert_array_equal(b, np.asarray(a))
+        assert b.dtype == np.float32
+
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        for v in [7, 3.5, True, False, "ivf_pq"]:
+            serialize_scalar(buf, v)
+        buf.seek(0)
+        assert deserialize_scalar(buf) == 7
+        assert deserialize_scalar(buf) == 3.5
+        assert deserialize_scalar(buf) is True
+        assert deserialize_scalar(buf) is False
+        assert deserialize_scalar(buf) == "ivf_pq"
+
+    def test_mixed_stream(self):
+        # index-file layout: scalars then array blocks (ivf_pq_serialize.cuh pattern)
+        buf = io.BytesIO()
+        serialize_scalar(buf, 2)
+        serialize_mdspan(buf, jnp.ones((2, 2)))
+        serialize_mdspan(buf, jnp.zeros((1, 3)))
+        buf.seek(0)
+        assert deserialize_scalar(buf) == 2
+        np.testing.assert_array_equal(deserialize_mdspan(buf), np.ones((2, 2)))
+        np.testing.assert_array_equal(deserialize_mdspan(buf), np.zeros((1, 3)))
+
+
+def test_mesh_fixture(mesh8):
+    assert mesh8.size == 8
