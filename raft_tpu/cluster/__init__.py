@@ -1,0 +1,1 @@
+"""raft_tpu.cluster — raft/cluster (K1-K3). Under construction."""

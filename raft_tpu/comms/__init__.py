@@ -1,0 +1,1 @@
+"""raft_tpu.comms — raft/comms (M1-M6). Under construction."""

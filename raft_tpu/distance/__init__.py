@@ -1,0 +1,19 @@
+"""raft_tpu.distance — pairwise distances and fused nearest-neighbor.
+
+Reference: cpp/include/raft/distance/ (L4) + pylibraft.distance (L6).
+"""
+
+from .fused_nn import fused_l2_nn, fused_l2_nn_argmin
+from .pairwise import distance, pairwise_distance
+from .types import DISTANCE_TYPES, SUPPORTED_DISTANCES, DistanceType, resolve_metric
+
+__all__ = [
+    "DistanceType",
+    "DISTANCE_TYPES",
+    "SUPPORTED_DISTANCES",
+    "resolve_metric",
+    "pairwise_distance",
+    "distance",
+    "fused_l2_nn",
+    "fused_l2_nn_argmin",
+]

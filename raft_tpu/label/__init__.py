@@ -1,0 +1,1 @@
+"""raft_tpu.label — raft/label (K6). Under construction."""

@@ -1,0 +1,1 @@
+"""raft_tpu.linalg — raft/linalg (P1-P6). Under construction."""

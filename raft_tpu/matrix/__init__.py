@@ -1,0 +1,44 @@
+"""raft_tpu.matrix — matrix utilities + top-k selection.
+
+Reference: cpp/include/raft/matrix/ (L3, P7/P8).
+"""
+
+from .ops import (
+    argmax,
+    argmin,
+    col_wise_sort,
+    copy,
+    eye,
+    fill,
+    gather,
+    gather_if,
+    get_diagonal,
+    linewise_op,
+    lower_triangular,
+    reverse,
+    set_diagonal,
+    sign_flip,
+    slice,
+    upper_triangular,
+)
+from .select_k import select_k
+
+__all__ = [
+    "select_k",
+    "argmax",
+    "argmin",
+    "gather",
+    "gather_if",
+    "slice",
+    "copy",
+    "fill",
+    "eye",
+    "linewise_op",
+    "col_wise_sort",
+    "reverse",
+    "sign_flip",
+    "upper_triangular",
+    "lower_triangular",
+    "get_diagonal",
+    "set_diagonal",
+]

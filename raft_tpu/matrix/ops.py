@@ -1,0 +1,136 @@
+"""Dense matrix utilities.
+
+Re-design of the reference's raft::matrix toolbox (cpp/include/raft/matrix/:
+argmax.cuh, argmin.cuh, gather.cuh, slice.cuh, copy.cuh, init.cuh,
+linewise_op.cuh, col_wise_sort.cuh, reverse.cuh, sign_flip.cuh,
+triangular.cuh, diagonal.cuh). Most entries are one-liner XLA compositions —
+they exist to give reference users a familiar, named surface; XLA fuses them
+into neighbors at compile time.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "gather",
+    "gather_if",
+    "slice",
+    "copy",
+    "fill",
+    "eye",
+    "linewise_op",
+    "col_wise_sort",
+    "reverse",
+    "sign_flip",
+    "upper_triangular",
+    "lower_triangular",
+    "get_diagonal",
+    "set_diagonal",
+]
+
+
+def argmax(m):
+    """Row-wise argmax (reference: matrix/argmax.cuh)."""
+    return jnp.argmax(jnp.asarray(m), axis=1).astype(jnp.int32)
+
+
+def argmin(m):
+    """Row-wise argmin (reference: matrix/argmin.cuh)."""
+    return jnp.argmin(jnp.asarray(m), axis=1).astype(jnp.int32)
+
+
+def gather(m, row_ids):
+    """Gather rows by index (reference: matrix/gather.cuh)."""
+    return jnp.take(jnp.asarray(m), jnp.asarray(row_ids), axis=0)
+
+
+def gather_if(m, row_ids, mask, fill_value=0):
+    """Gather rows where ``mask`` holds, else a fill row (reference: gatherIf)."""
+    out = gather(m, row_ids)
+    return jnp.where(jnp.asarray(mask)[:, None], out, fill_value)
+
+
+def slice(m, row_start, row_end, col_start=0, col_end=None):  # noqa: A001 (ref name)
+    """Submatrix copy (reference: matrix/slice.cuh)."""
+    m = jnp.asarray(m)
+    col_end = m.shape[1] if col_end is None else col_end
+    return m[row_start:row_end, col_start:col_end]
+
+
+def copy(m):
+    """Materialized copy (reference: matrix/copy.cuh)."""
+    return jnp.array(jnp.asarray(m), copy=True)
+
+
+def fill(shape, value, dtype=jnp.float32):
+    """Constant-initialized matrix (reference: matrix/init.cuh)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def eye(n, dtype=jnp.float32):
+    return jnp.eye(n, dtype=dtype)
+
+
+def linewise_op(m, vec, along_rows: bool, op):
+    """Broadcast a vector op along rows or columns (reference: matrix/linewise_op.cuh;
+    the linalg matrix_vector_op in its matrix form)."""
+    m = jnp.asarray(m)
+    vec = jnp.asarray(vec)
+    if along_rows:
+        expects(vec.shape[0] == m.shape[1], "row-wise vector must have len n_cols")
+        return op(m, vec[None, :])
+    expects(vec.shape[0] == m.shape[0], "col-wise vector must have len n_rows")
+    return op(m, vec[:, None])
+
+
+def col_wise_sort(m, ascending: bool = True):
+    """Sort each row's entries (reference: matrix/col_wise_sort.cuh — CUB
+    segmented sort; here one fused XLA sort). Returns (sorted, source_indices).
+    Descending order reverses the ascending sort (no negation, so unsigned and
+    boolean dtypes sort correctly)."""
+    m = jnp.asarray(m)
+    order = jnp.argsort(m, axis=1, stable=True)
+    if not ascending:
+        order = order[:, ::-1]
+    return jnp.take_along_axis(m, order, axis=1), order.astype(jnp.int32)
+
+
+def reverse(m, along_rows: bool = True):
+    """Reverse entries within each row (``along_rows=True``, the reference's
+    col_reverse — column order swaps) or within each column (row order swaps,
+    row_reverse) (reference: matrix/reverse.cuh)."""
+    return jnp.flip(jnp.asarray(m), axis=1 if along_rows else 0)
+
+
+def sign_flip(m):
+    """Flip each column's sign so its max-|.| entry is positive — SVD/eig sign
+    canonicalization (reference: matrix/detail/math.cuh signFlip)."""
+    m = jnp.asarray(m)
+    piv = jnp.take_along_axis(m, jnp.argmax(jnp.abs(m), axis=0)[None, :], axis=0)
+    return m * jnp.where(piv < 0, -1.0, 1.0)
+
+
+def upper_triangular(m):
+    """Reference: matrix/triangular.cuh."""
+    return jnp.triu(jnp.asarray(m))
+
+
+def lower_triangular(m):
+    return jnp.tril(jnp.asarray(m))
+
+
+def get_diagonal(m):
+    """Reference: matrix/diagonal.cuh."""
+    return jnp.diagonal(jnp.asarray(m))
+
+
+def set_diagonal(m, d):
+    m = jnp.asarray(m)
+    n = min(m.shape)
+    idx = jnp.arange(n)
+    return m.at[idx, idx].set(jnp.asarray(d)[:n])

@@ -1,0 +1,54 @@
+"""Batched top-k selection with index payloads.
+
+Re-design of the reference's select_k (cpp/include/raft/matrix/select_k.cuh;
+two CUDA algorithms — 11-bit radix filter detail/select_radix.cuh and warp
+bitonic queues detail/select_warpsort.cuh — picked by a learned heuristic,
+detail/select_k-inl.cuh:46). On TPU the baseline is XLA's native TopK
+(`lax.top_k`), which lowers to a tuned sort-based selector; a Pallas
+block-bitonic variant for very large n lives in raft_tpu.ops. The payload
+(caller-provided source indices, used when merging per-shard candidate lists)
+is carried by gathering with the top-k permutation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+
+__all__ = ["select_k"]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min"))
+def _select_k(values, in_idx, k: int, select_min: bool):
+    v = -values if select_min else values
+    top_v, top_i = lax.top_k(v, k)  # ties resolved by lowest index, like the ref
+    if select_min:
+        top_v = -top_v
+    if in_idx is not None:
+        top_i = jnp.take_along_axis(in_idx, top_i, axis=1)
+    return top_v, top_i.astype(jnp.int32)
+
+
+def select_k(values, k: int, select_min: bool = True, indices=None):
+    """Select the k smallest (or largest) entries per row, with their indices.
+
+    Reference: raft::matrix::select_k (matrix/select_k.cuh) and the pylibraft
+    binding (matrix/select_k.pyx). ``indices`` optionally supplies the payload
+    ids of each column (shape == values.shape); by default the column offsets
+    0..n-1 are returned — exactly the reference's in_idx=nullopt behavior.
+
+    Returns ``(out_values (m, k), out_indices (m, k) int32)``.
+    """
+    values = jnp.asarray(values)
+    expects(values.ndim == 2, "select_k expects a 2-D (batch, n) matrix")
+    n = values.shape[1]
+    expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
+    if indices is not None:
+        indices = jnp.asarray(indices)
+        expects(indices.shape == values.shape, "indices payload must match values shape")
+    return _select_k(values, indices, int(k), bool(select_min))

@@ -1,0 +1,1 @@
+"""raft_tpu.neighbors — raft/neighbors (N1-N10). Under construction."""

@@ -1,0 +1,1 @@
+"""raft_tpu.ops — Pallas TPU kernels backing hot paths. Under construction."""

@@ -1,0 +1,1 @@
+"""raft_tpu.parallel — distributed algorithm drivers over raft_tpu.comms. Under construction."""

@@ -1,0 +1,92 @@
+"""Synthetic dataset generators.
+
+Re-design of the reference's generators (cpp/include/raft/random/make_blobs.cuh,
+make_regression.cuh, multi_variable_gaussian.cuh — the latter using cusolver
+potrf; here `jnp.linalg.cholesky`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from .rng import as_key
+
+__all__ = ["make_blobs", "make_regression", "multi_variable_gaussian"]
+
+
+def make_blobs(
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 3,
+    cluster_std: float = 1.0,
+    centers=None,
+    center_box=(-10.0, 10.0),
+    shuffle: bool = True,
+    seed=0,
+    dtype=jnp.float32,
+):
+    """Gaussian-blob clusters (reference: random/make_blobs.cuh).
+
+    Returns ``(X (n_samples, n_features), labels (n_samples,) int32)``.
+    ``centers`` may be a precomputed (n_clusters, n_features) array.
+    """
+    key = as_key(seed)
+    kc, kl, kn, ks = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            kc, (n_clusters, n_features), dtype=dtype, minval=center_box[0], maxval=center_box[1]
+        )
+    else:
+        centers = jnp.asarray(centers, dtype=dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(kl, (n_samples,), 0, n_clusters, dtype=jnp.int32)
+    noise = jax.random.normal(kn, (n_samples, n_features), dtype=dtype) * cluster_std
+    x = jnp.take(centers, labels, axis=0) + noise
+    if shuffle:
+        perm = jax.random.permutation(ks, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels
+
+
+def make_regression(
+    n_samples: int,
+    n_features: int,
+    n_informative: int | None = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    seed=0,
+    dtype=jnp.float32,
+):
+    """Linear-model regression data (reference: random/make_regression.cuh).
+
+    Returns ``(X, y, coef)`` with ``y = X @ coef + bias + N(0, noise)``.
+    """
+    n_informative = n_features if n_informative is None else min(n_informative, n_features)
+    key = as_key(seed)
+    kx, kw, kn, ks = jax.random.split(key, 4)
+    x = jax.random.normal(kx, (n_samples, n_features), dtype=dtype)
+    coef = jnp.zeros((n_features, n_targets), dtype=dtype)
+    w = 100.0 * jax.random.uniform(kw, (n_informative, n_targets), dtype=dtype)
+    coef = coef.at[:n_informative].set(w)
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(kn, y.shape, dtype=dtype)
+    if shuffle:
+        perm = jax.random.permutation(ks, n_samples)
+        x, y = x[perm], y[perm]
+    return x, jnp.squeeze(y, axis=1) if n_targets == 1 else y, coef
+
+
+def multi_variable_gaussian(rng, mean, cov, n_samples: int, dtype=jnp.float32):
+    """Samples from N(mean, cov) via Cholesky (reference:
+    random/multi_variable_gaussian.cuh, cusolver potrf path)."""
+    mean = jnp.asarray(mean, dtype=dtype)
+    cov = jnp.asarray(cov, dtype=dtype)
+    expects(cov.shape == (mean.shape[0], mean.shape[0]), "cov must be (d, d)")
+    chol = jnp.linalg.cholesky(cov + 1e-6 * jnp.eye(cov.shape[0], dtype=dtype))
+    z = jax.random.normal(as_key(rng), (n_samples, mean.shape[0]), dtype=dtype)
+    return mean[None, :] + z @ chol.T

@@ -1,0 +1,119 @@
+"""Random number generation.
+
+Re-design of the reference's device RNG (cpp/include/raft/random/rng.cuh,
+rng_state.hpp:28-32 GeneratorType{Philox,PCG,...}). The counter-based design
+goal — reproducible, order-independent streams — is native to JAX
+(threefry); per SURVEY.md §2.3 we keep the *API* (RngState + distribution
+fillers), not the generator internals. ``RngState(seed)`` carries a JAX PRNG
+key and hands out independent subkeys per call, so repeated calls draw fresh
+values exactly like the reference's advancing state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "RngState",
+    "as_key",
+    "uniform",
+    "uniform_int",
+    "normal",
+    "lognormal",
+    "gumbel",
+    "logistic",
+    "exponential",
+    "rayleigh",
+    "laplace",
+    "bernoulli",
+    "scaled_bernoulli",
+    "discrete",
+]
+
+
+@dataclasses.dataclass
+class RngState:
+    """Mutable RNG stream (reference: raft::random::RngState, rng_state.hpp).
+
+    Each distribution call consumes one subkey, so successive calls are
+    independent — mirroring the reference's advancing counter.
+    """
+
+    seed: int = 0
+
+    def __post_init__(self):
+        self._key = jax.random.key(self.seed)
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def advance(self, n: int = 1) -> None:
+        for _ in range(n):
+            self._key, _ = jax.random.split(self._key)
+
+
+def as_key(rng):
+    """Accept an RngState, an int seed, or a raw JAX key."""
+    if isinstance(rng, RngState):
+        return rng.next_key()
+    if isinstance(rng, int):
+        return jax.random.key(rng)
+    return rng
+
+
+def uniform(rng, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    """Reference: rng.cuh uniform()."""
+    return jax.random.uniform(as_key(rng), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(rng, shape, low, high, dtype=jnp.int32):
+    return jax.random.randint(as_key(rng), shape, low, high, dtype=dtype)
+
+
+def normal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(as_key(rng), shape, dtype=dtype)
+
+
+def lognormal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def gumbel(rng, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(as_key(rng), shape, dtype=dtype)
+
+
+def logistic(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(as_key(rng), shape, dtype=dtype)
+
+
+def exponential(rng, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(as_key(rng), shape, dtype=dtype) / lam
+
+
+def rayleigh(rng, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(as_key(rng), shape, dtype=dtype, minval=jnp.finfo(dtype).tiny)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def laplace(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(as_key(rng), shape, dtype=dtype)
+
+
+def bernoulli(rng, shape, prob=0.5):
+    return jax.random.bernoulli(as_key(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, prob=0.5, scale=1.0, dtype=jnp.float32):
+    """Reference: rng.cuh scaled_bernoulli — ±scale with probability prob."""
+    b = jax.random.bernoulli(as_key(rng), prob, shape)
+    return jnp.where(b, scale, -scale).astype(dtype)
+
+
+def discrete(rng, shape, weights):
+    """Sample indices proportional to weights (reference: rng.cuh discrete)."""
+    logits = jnp.log(jnp.maximum(jnp.asarray(weights, jnp.float32), 1e-30))
+    return jax.random.categorical(as_key(rng), logits, shape=shape).astype(jnp.int32)

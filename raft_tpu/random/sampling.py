@@ -1,0 +1,44 @@
+"""Permutation and sampling.
+
+Reference: cpp/include/raft/random/permute.cuh and
+random/sample_without_replacement.cuh (weighted reservoir-free variant).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from .rng import as_key
+
+__all__ = ["permute", "sample_without_replacement", "excess_subsample"]
+
+
+def permute(rng, x):
+    """Random row permutation; returns (permuted_rows, permutation_indices)
+    (reference: random/permute.cuh)."""
+    x = jnp.asarray(x)
+    perm = jax.random.permutation(as_key(rng), x.shape[0])
+    return jnp.take(x, perm, axis=0), perm.astype(jnp.int32)
+
+
+def sample_without_replacement(rng, n_population: int, n_samples: int, weights=None):
+    """Draw distinct indices, optionally weighted (reference:
+    random/sample_without_replacement.cuh — Gumbel-top-k style on TPU)."""
+    expects(n_samples <= n_population, "cannot sample %d from %d", n_samples, n_population)
+    key = as_key(rng)
+    if weights is None:
+        return jax.random.permutation(key, n_population)[:n_samples].astype(jnp.int32)
+    w = jnp.maximum(jnp.asarray(weights, jnp.float32), 0.0)
+    # Gumbel-top-k = weighted sampling without replacement in one vector op.
+    g = jax.random.gumbel(key, (n_population,)) + jnp.log(jnp.maximum(w, 1e-30))
+    return jax.lax.top_k(g, n_samples)[1].astype(jnp.int32)
+
+
+def excess_subsample(rng, n_population: int, n_samples: int):
+    """Uniform subsample of row ids, sorted ascending — the dataset-subsetting
+    helper IVF builds use (reference: random/detail/rng_impl.hpp usage in
+    neighbors/detail/ivf_pq_build.cuh)."""
+    idx = sample_without_replacement(rng, n_population, n_samples)
+    return jnp.sort(idx)

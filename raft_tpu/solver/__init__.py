@@ -1,0 +1,1 @@
+"""raft_tpu.solver — raft/solver + raft/sparse/solver (S8-S9, K5). Under construction."""

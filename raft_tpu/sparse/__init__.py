@@ -1,0 +1,1 @@
+"""raft_tpu.sparse — raft/sparse (S1-S7). Under construction."""

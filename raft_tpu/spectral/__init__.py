@@ -1,0 +1,1 @@
+"""raft_tpu.spectral — raft/spectral (K4). Under construction."""

@@ -1,0 +1,1 @@
+"""raft_tpu.stats — raft/stats (P10-P11). Under construction."""

@@ -1,0 +1,1 @@
+"""raft_tpu.utils — misc helpers (ref: raft/util residue). Under construction."""

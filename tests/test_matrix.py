@@ -1,0 +1,102 @@
+"""Matrix ops + select_k tests (reference analogue: cpp/test/matrix/*, MATRIX_TEST;
+select_k harness cpp/internal/raft_internal/matrix/select_k.cuh)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+from raft_tpu.core import RaftError
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("k", [1, 5, 16])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_matches_numpy(self, rng, k, select_min):
+        v = rng.random((13, 50)).astype(np.float32)
+        vals, idx = matrix.select_k(v, k, select_min=select_min)
+        order = np.argsort(v if select_min else -v, axis=1)[:, :k]
+        np.testing.assert_allclose(
+            np.sort(np.asarray(vals), axis=1),
+            np.sort(np.take_along_axis(v, order, 1), axis=1),
+            rtol=1e-6,
+        )
+        # indices must address the selected values
+        np.testing.assert_allclose(
+            np.take_along_axis(v, np.asarray(idx), 1), np.asarray(vals), rtol=1e-6
+        )
+
+    def test_payload_indices(self, rng):
+        v = rng.random((4, 20)).astype(np.float32)
+        payload = rng.integers(0, 10_000, (4, 20)).astype(np.int32)
+        vals, idx = matrix.select_k(v, 3, indices=payload)
+        pos = np.argsort(v, axis=1)[:, :3]
+        got = np.sort(np.asarray(idx), axis=1)
+        want = np.sort(np.take_along_axis(payload, pos, 1), axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_k_equals_n(self, rng):
+        v = rng.random((3, 8)).astype(np.float32)
+        vals, idx = matrix.select_k(v, 8)
+        np.testing.assert_allclose(np.asarray(vals), np.sort(v, axis=1), rtol=1e-6)
+
+    def test_k_out_of_range(self):
+        with pytest.raises(RaftError):
+            matrix.select_k(np.zeros((2, 4)), 5)
+        with pytest.raises(RaftError):
+            matrix.select_k(np.zeros((2, 4)), 0)
+
+
+class TestOps:
+    def test_argmax_argmin(self, rng):
+        m = rng.random((10, 7)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(m)), m.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(m)), m.argmin(1))
+
+    def test_gather(self, rng):
+        m = rng.random((10, 4)).astype(np.float32)
+        ids = np.array([3, 1, 7])
+        np.testing.assert_array_equal(np.asarray(matrix.gather(m, ids)), m[ids])
+
+    def test_gather_if(self, rng):
+        m = rng.random((10, 4)).astype(np.float32)
+        ids = np.array([0, 1, 2])
+        mask = np.array([True, False, True])
+        out = np.asarray(matrix.gather_if(m, ids, mask))
+        np.testing.assert_array_equal(out[0], m[0])
+        np.testing.assert_array_equal(out[1], np.zeros(4))
+
+    def test_slice(self, rng):
+        m = rng.random((6, 6)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.slice(m, 1, 4, 2, 5)), m[1:4, 2:5])
+
+    def test_col_wise_sort(self, rng):
+        m = rng.random((5, 9)).astype(np.float32)
+        s, order = matrix.col_wise_sort(m)
+        np.testing.assert_allclose(np.asarray(s), np.sort(m, axis=1), rtol=1e-6)
+        np.testing.assert_array_equal(np.take_along_axis(m, np.asarray(order), 1), np.asarray(s))
+
+    def test_linewise_op(self, rng):
+        m = rng.random((4, 6)).astype(np.float32)
+        v = rng.random(6).astype(np.float32)
+        out = np.asarray(matrix.linewise_op(m, v, along_rows=True, op=jnp.add))
+        np.testing.assert_allclose(out, m + v[None, :], rtol=1e-6)
+
+    def test_sign_flip(self, rng):
+        m = rng.standard_normal((8, 3)).astype(np.float32)
+        out = np.asarray(matrix.sign_flip(m))
+        piv = np.take_along_axis(out, np.abs(out).argmax(0)[None, :], 0)
+        assert (piv >= 0).all()
+        np.testing.assert_allclose(np.abs(out), np.abs(m), rtol=1e-6)
+
+    def test_triangular_diagonal(self, rng):
+        m = rng.random((5, 5)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.upper_triangular(m)), np.triu(m))
+        np.testing.assert_array_equal(np.asarray(matrix.get_diagonal(m)), np.diag(m))
+        out = np.asarray(matrix.set_diagonal(m, np.zeros(5)))
+        np.testing.assert_allclose(np.diag(out), 0.0)
+
+    def test_reverse(self, rng):
+        m = rng.random((4, 5)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.reverse(m)), m[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.reverse(m, along_rows=False)), m[::-1])

@@ -1,0 +1,129 @@
+"""Random generation tests (reference analogue: cpp/test/random/*, RANDOM_TEST)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import random as rr
+from raft_tpu.core import RaftError
+
+
+class TestDistributions:
+    def test_uniform_range_and_moments(self):
+        x = np.asarray(rr.uniform(rr.RngState(1), (20000,), low=2.0, high=4.0))
+        assert x.min() >= 2.0 and x.max() < 4.0
+        assert abs(x.mean() - 3.0) < 0.02
+
+    def test_normal_moments(self):
+        x = np.asarray(rr.normal(rr.RngState(2), (20000,), mu=1.0, sigma=2.0))
+        assert abs(x.mean() - 1.0) < 0.05
+        assert abs(x.std() - 2.0) < 0.05
+
+    def test_rngstate_advances(self):
+        st = rr.RngState(3)
+        a = np.asarray(rr.uniform(st, (10,)))
+        b = np.asarray(rr.uniform(st, (10,)))
+        assert not np.allclose(a, b)
+
+    def test_seed_reproducible(self):
+        a = np.asarray(rr.uniform(rr.RngState(7), (10,)))
+        b = np.asarray(rr.uniform(rr.RngState(7), (10,)))
+        np.testing.assert_array_equal(a, b)
+
+    def test_bernoulli(self):
+        x = np.asarray(rr.bernoulli(rr.RngState(4), (10000,), prob=0.25))
+        assert abs(x.mean() - 0.25) < 0.02
+
+    def test_discrete_weights(self):
+        w = np.array([0.0, 1.0, 3.0])
+        x = np.asarray(rr.discrete(rr.RngState(5), (12000,), w))
+        assert (x > 0).all()
+        assert abs((x == 2).mean() - 0.75) < 0.02
+
+    @pytest.mark.parametrize("fn", ["lognormal", "gumbel", "logistic", "exponential", "rayleigh", "laplace"])
+    def test_shapes_finite(self, fn):
+        x = np.asarray(getattr(rr, fn)(rr.RngState(6), (100,)))
+        assert x.shape == (100,) and np.isfinite(x).all()
+
+
+class TestMakeBlobs:
+    def test_shapes_and_labels(self):
+        x, labels = rr.make_blobs(500, 8, n_clusters=5, seed=0)
+        assert x.shape == (500, 8)
+        assert labels.shape == (500,)
+        assert set(np.unique(np.asarray(labels))) <= set(range(5))
+
+    def test_tight_clusters_are_separable(self):
+        x, labels = rr.make_blobs(400, 4, n_clusters=3, cluster_std=0.01, seed=1)
+        x, labels = np.asarray(x), np.asarray(labels)
+        # points with the same label should be far closer than different labels
+        for lbl in range(3):
+            pts = x[labels == lbl]
+            if len(pts) > 1:
+                assert np.std(pts, axis=0).max() < 0.1
+
+    def test_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        x, labels = rr.make_blobs(100, 2, centers=centers, cluster_std=0.1, seed=2)
+        x, labels = np.asarray(x), np.asarray(labels)
+        np.testing.assert_allclose(x[labels == 1].mean(0), [100, 100], atol=1.0)
+
+
+class TestMakeRegression:
+    def test_recoverable_linear_model(self):
+        x, y, coef = rr.make_regression(200, 5, noise=0.0, seed=0)
+        x, y, coef = np.asarray(x), np.asarray(y), np.asarray(coef)
+        np.testing.assert_allclose(x @ coef[:, 0], y, rtol=1e-3, atol=1e-2)
+
+
+class TestMVG:
+    def test_multi_variable_gaussian(self):
+        mean = np.array([1.0, -2.0], np.float32)
+        cov = np.array([[2.0, 0.6], [0.6, 1.0]], np.float32)
+        s = np.asarray(rr.multi_variable_gaussian(0, mean, cov, 30000))
+        np.testing.assert_allclose(s.mean(0), mean, atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+
+class TestSampling:
+    def test_permute(self):
+        x = np.arange(40).reshape(10, 4).astype(np.float32)
+        out, perm = rr.permute(0, x)
+        np.testing.assert_array_equal(np.asarray(out), x[np.asarray(perm)])
+        assert sorted(np.asarray(perm)) == list(range(10))
+
+    def test_sample_without_replacement_distinct(self):
+        idx = np.asarray(rr.sample_without_replacement(1, 100, 50))
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_weighted_sampling_respects_zero_weight(self):
+        w = np.ones(20)
+        w[7] = 0.0
+        for seed in range(5):
+            idx = np.asarray(rr.sample_without_replacement(seed, 20, 10, weights=w))
+            assert 7 not in idx
+
+    def test_oversample_raises(self):
+        with pytest.raises(RaftError):
+            rr.sample_without_replacement(0, 5, 6)
+
+
+class TestRmat:
+    def test_ranges_and_determinism(self):
+        theta = [0.57, 0.19, 0.19, 0.05]
+        src, dst = rr.rmat(0, theta, r_scale=10, c_scale=8, n_edges=5000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.min() >= 0 and src.max() < 2**10
+        assert dst.min() >= 0 and dst.max() < 2**8
+        s2, d2 = rr.rmat(0, theta, 10, 8, 5000)
+        np.testing.assert_array_equal(src, np.asarray(s2))
+
+    def test_skew(self):
+        # heavily a-biased theta concentrates edges near (0, 0)
+        src, dst = rr.rmat(1, [0.9, 0.03, 0.03, 0.04], 12, 12, 4000)
+        assert np.median(np.asarray(src)) < 2**12 / 8
+
+    def test_per_level_theta(self):
+        theta = np.tile(np.array([0.25, 0.25, 0.25, 0.25]), (12, 1))
+        src, dst = rr.rmat(2, theta, 12, 12, 1000)
+        assert np.asarray(src).max() < 2**12
