@@ -1,0 +1,55 @@
+"""raft_tpu benchmark entry point (run by the driver on real TPU hardware).
+
+Prints ONE JSON line: the flagship metric is exact-kNN search throughput
+(QPS) on a synthetic 100k x 128 dataset, k=10 — the brute-force operating
+point of the reference's ANN harness (cpp/bench/ann, batch-mode QPS metric,
+cpp/bench/ann/src/common/benchmark.hpp:168). The reference publishes no
+numbers (BASELINE.md), so vs_baseline is reported as 1.0 by definition of
+"no published baseline"; cross-framework comparison happens via the recorded
+absolute QPS.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu.neighbors import knn
+
+    n, d, m, k = 100_000, 128, 10_000, 10
+    rng = np.random.default_rng(0)
+    dataset = jnp.asarray(rng.random((n, d), np.float32))
+    queries = jnp.asarray(rng.random((m, d), np.float32))
+
+    # warmup / compile
+    out = knn(dataset, queries, k, metric="sqeuclidean")
+    jax.block_until_ready(out)
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = knn(dataset, queries, k, metric="sqeuclidean")
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    qps = m / dt
+    print(
+        json.dumps(
+            {
+                "metric": "brute-force kNN QPS (100k x 128 f32, k=10, batch 10k)",
+                "value": round(qps, 1),
+                "unit": "QPS",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

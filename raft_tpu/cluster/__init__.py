@@ -1,1 +1,16 @@
-"""raft_tpu.cluster — raft/cluster (K1-K3). Under construction."""
+"""raft_tpu.cluster — k-means family and (later) single-linkage.
+
+Reference: cpp/include/raft/cluster/ (L4, K1-K3).
+"""
+
+from . import kmeans, kmeans_balanced
+from .kmeans import KMeansOutput, KMeansParams
+from .kmeans_balanced import KMeansBalancedParams
+
+__all__ = [
+    "kmeans",
+    "kmeans_balanced",
+    "KMeansParams",
+    "KMeansOutput",
+    "KMeansBalancedParams",
+]

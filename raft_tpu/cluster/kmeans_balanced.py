@@ -1,0 +1,163 @@
+"""Balanced k-means — the coarse quantizer trainer for IVF indexes.
+
+Re-design of the reference's kmeans_balanced
+(cpp/include/raft/cluster/kmeans_balanced.cuh, detail/kmeans_balanced.cuh:
+EM loop balancing_em_iters :618, center adjustment adjust_centers :524,
+assignment predict :371 / minibatch predict_core :85, hierarchical
+build_hierarchical :758). Differences from plain k-means: a fixed number of
+EM iterations (no tol), and a balancing step that re-seeds centers of
+under-populated clusters from members of over-populated ones so inverted
+lists stay usable.
+
+TPU shape: assignment is the fused-1-NN GEMM; the balancing step is fully
+vectorized — small clusters are detected with a size threshold and their
+centers replaced by data points drawn (categorical, size-weighted) from large
+clusters, in one masked gather instead of the reference's sequential
+per-center scan.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance.fused_nn import _fused_l2_nn
+from ..distance.pairwise import _choose_tile
+from ..random.rng import as_key
+
+__all__ = ["KMeansBalancedParams", "fit", "predict", "fit_predict", "build_clusters"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KMeansBalancedParams:
+    """Reference: kmeans_balanced_params (cluster/kmeans_balanced_types.hpp)."""
+
+    n_iters: int = 20
+    # assignment metric: the reference supports L2Expanded and InnerProduct
+    # (kmeans_balanced.cuh requirement); same pair here.
+    metric: str = "sqeuclidean"
+    seed: int = 0
+    # clusters smaller than avg_size * small_ratio get re-seeded (ref:
+    # adjust_centers' threshold logic)
+    small_ratio: float = 0.25
+    max_train_points: int | None = None  # subsample cap for fit (ref: IVF builds train on a subset)
+
+
+def _assign_labels(x, centers, tile: int, inner: bool):
+    if inner:
+        # inner-product assignment: argmax of the score GEMM
+        scores = x.astype(jnp.float32) @ centers.T
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+    return _fused_l2_nn(x, centers, False, tile)[1]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_iters", "small_ratio", "tile", "inner"))
+def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float, tile: int, inner: bool):
+    n = x.shape[0]
+    xf = x.astype(jnp.float32)
+
+    def body(i, carry):
+        centers, key = carry
+        labels = _assign_labels(x, centers, tile, inner)
+        onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)  # (k, n)
+        sums = onehot @ xf
+        counts = jnp.sum(onehot, axis=1)
+        centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
+
+        # -- balancing (ref: adjust_centers :524) --
+        avg = n / k
+        small = counts < (avg * small_ratio)  # (k,)
+        key, kc = jax.random.split(key)
+        # draw replacement points, favoring members of crowded clusters
+        point_w = counts[labels]  # crowdedness of each point's cluster
+        logits = jnp.log(jnp.maximum(point_w, 1e-6))
+        repl_idx = jax.random.categorical(kc, logits, shape=(k,))
+        repl = xf[repl_idx]
+        centers = jnp.where(small[:, None], repl, centers)
+        return centers, key
+
+    centers, _ = lax.fori_loop(0, n_iters, body, (init_centers.astype(jnp.float32), key))
+    # final sharpening pass without balancing so centers are true means
+    labels = _assign_labels(x, centers, tile, inner)
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)
+    sums = onehot @ xf
+    counts = jnp.sum(onehot, axis=1)
+    centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
+    return centers
+
+
+def fit(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None = None):
+    """Train balanced cluster centers (reference: kmeans_balanced::fit).
+
+    Returns (n_clusters, d) float32 centers.
+    """
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    expects(x.ndim == 2, "X must be 2-D")
+    n = x.shape[0]
+    expects(n_clusters <= n, "n_clusters > n_samples")
+    key = as_key(params.seed)
+
+    if params.max_train_points is not None and n > params.max_train_points:
+        key, ks = jax.random.split(key)
+        sub = jax.random.choice(ks, n, (params.max_train_points,), replace=False)
+        x = jnp.take(x, sub, axis=0)
+        n = params.max_train_points
+
+    key, ki, ke = jax.random.split(key, 3)
+    init_idx = jax.random.choice(ki, n, (n_clusters,), replace=False)
+    init_centers = jnp.take(x, init_idx, axis=0)
+    tile = _choose_tile(n, n_clusters, 1, res.workspace_bytes)
+    return _balanced_em(
+        x, init_centers, ke, n_clusters, params.n_iters, params.small_ratio, tile,
+        _is_inner(params.metric),
+    )
+
+
+def _is_inner(metric: str) -> bool:
+    from ..distance.types import DistanceType, resolve_metric
+
+    mt = resolve_metric(metric)
+    expects(
+        mt
+        in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.L2Unexpanded,
+            DistanceType.L2SqrtUnexpanded,
+            DistanceType.InnerProduct,
+        ),
+        "kmeans_balanced supports L2 / inner_product metrics, got %s",
+        mt.name,
+    )
+    return mt == DistanceType.InnerProduct
+
+
+def predict(x, centers, metric: str = "sqeuclidean", res: Resources | None = None):
+    """Nearest-center labels (reference: kmeans_balanced::predict)."""
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    centers = jnp.asarray(centers)
+    tile = _choose_tile(x.shape[0], centers.shape[0], 1, res.workspace_bytes)
+    return _assign_labels(x, centers, tile, _is_inner(metric))
+
+
+def fit_predict(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None = None):
+    centers = fit(params, x, n_clusters, res=res)
+    return centers, predict(x, centers, metric=params.metric, res=res)
+
+
+def build_clusters(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None = None):
+    """Train + assign + sizes in one call — the IVF-build entry point
+    (reference: detail::kmeans_balanced::build_clusters, used by
+    ivf_pq_build.cuh:412). Returns (centers, labels, cluster_sizes)."""
+    centers = fit(params, x, n_clusters, res=res)
+    labels = predict(x, centers, metric=params.metric, res=res)
+    sizes = jnp.bincount(labels, length=n_clusters).astype(jnp.int32)
+    return centers, labels, sizes

@@ -1,1 +1,30 @@
-"""raft_tpu.comms — raft/comms (M1-M6). Under construction."""
+"""raft_tpu.comms — the communicator, TPU-native.
+
+Re-design of the reference's raft::comms stack (cpp/include/raft/core/comms.hpp:
+comms_iface :125-230 / comms_t :242; NCCL+UCX std_comms comms/std_comms.hpp:69,
+MPI alt-impl comms/mpi_comms.hpp; Dask bootstrap raft_dask/common/comms.py:39).
+
+On TPU the transport is ICI/DCN driven by XLA collectives, so the communicator
+is not a handle owning sockets — it is a *naming veneer* over mesh axes:
+
+- construction = pick a ``jax.sharding.Mesh`` + axis name(s) (the analogue of
+  building an NCCL clique; ``jax.distributed.initialize()`` is the multi-host
+  bootstrap, replacing the NCCL-unique-id exchange of std_comms :69-115);
+- the collective *methods* (allreduce/allgather/reducescatter/ppermute/...)
+  are meaningful **inside** ``shard_map`` over that mesh — each lowers to one
+  XLA collective on ICI (SURVEY.md §2.2 mapping);
+- ``comm_split`` = operating over a different mesh axis (XLA partitions
+  collectives per axis, which is what sub-communicators exist for);
+- sync/abort semantics (comms/detail/util.hpp:109-136 NCCL async-error
+  polling) collapse into XLA/PJRT error propagation — a failed collective
+  raises at block_until_ready.
+
+``Comms`` carries (mesh, axis) so distributed algorithms are written against
+the same vocabulary the reference documents in docs/source/using_comms.rst.
+"""
+
+from . import test_utils
+from .bootstrap import initialize, local_mesh
+from .comms import Comms, replicated, shard_along
+
+__all__ = ["Comms", "shard_along", "replicated", "initialize", "local_mesh", "test_utils"]

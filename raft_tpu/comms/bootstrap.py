@@ -1,0 +1,39 @@
+"""Cluster bootstrap.
+
+Re-design of the reference's two bootstrap paths — raft-dask's
+NCCL-unique-id + UCX endpoint exchange over a Dask cluster
+(raft_dask/common/comms.py:85-230, SURVEY.md §3.F) and mpi_comms' MPI-driven
+id broadcast (comms/mpi_comms.hpp). On TPU both collapse into
+``jax.distributed.initialize`` + mesh construction: the TPU runtime already
+knows the pod topology, so there is no id exchange to orchestrate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .comms import Comms
+
+__all__ = ["initialize", "local_mesh"]
+
+
+def initialize(coordinator_address: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
+    """Multi-host bootstrap (reference analogue: Comms.init,
+    raft_dask/common/comms.py:172 — NCCL id broadcast + handle injection).
+
+    On a TPU pod slice each host calls this once before building meshes; with
+    no arguments JAX auto-discovers the topology from the TPU environment.
+    """
+    jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def local_mesh(axis: str = "data", n_devices: int | None = None) -> Comms:
+    """Build a 1-D mesh over (up to) all visible devices and return its
+    communicator — the single-host analogue of a raft-dask session."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Comms(Mesh(np.array(devs), (axis,)), axis)

@@ -1,0 +1,116 @@
+"""Collective self-tests, runnable on any mesh.
+
+Re-design of the reference's comms test kernels
+(cpp/include/raft/comms/comms_test.hpp, detail/test.hpp:
+test_collective_allreduce/broadcast/reduce/allgather/gather/gatherv/
+reducescatter, test_pointToPoint_sendrecv, test_commsplit — the functions
+raft-dask exposes as perform_test_comms_* (comms_utils.pyx:78-244)). Each
+returns True iff every shard observed the mathematically expected value, and
+runs as one small shard_map program.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .comms import Comms
+
+__all__ = [
+    "test_collective_allreduce",
+    "test_collective_broadcast",
+    "test_collective_reduce",
+    "test_collective_allgather",
+    "test_collective_reducescatter",
+    "test_pointtopoint_ring",
+    "test_commsplit",
+    "run_all",
+]
+
+
+def _all_shards_ok(comms: Comms, ok_fn):
+    """Run ok_fn per shard; AND the verdicts across the clique."""
+
+    def prog():
+        ok = ok_fn(comms)
+        return comms.allreduce(ok.astype(jnp.int32), "min")
+
+    out = comms.shard_map(prog, in_specs=(), out_specs=P())()
+    return bool(out == 1)
+
+
+def test_collective_allreduce(comms: Comms) -> bool:
+    """Each rank contributes 1; everyone must see size (ref: detail/test.hpp:45)."""
+    return _all_shards_ok(
+        comms, lambda c: c.allreduce(jnp.ones(()), "sum") == c.size()
+    )
+
+
+def test_collective_broadcast(comms: Comms) -> bool:
+    """Root holds its rank+42; everyone must see 42 (ref: test_collective_bcast)."""
+    return _all_shards_ok(
+        comms, lambda c: c.bcast(jnp.where(c.rank() == 0, 42.0, -1.0), root=0) == 42.0
+    )
+
+
+def test_collective_reduce(comms: Comms) -> bool:
+    return _all_shards_ok(
+        comms,
+        lambda c: c.reduce(c.rank().astype(jnp.float32), root=0)
+        == c.size() * (c.size() - 1) / 2,
+    )
+
+
+def test_collective_allgather(comms: Comms) -> bool:
+    """Rank r contributes r; gathered vector must be 0..size-1."""
+
+    def ok(c: Comms):
+        g = c.allgather(c.rank().astype(jnp.float32)[None])
+        want = jnp.arange(c.size(), dtype=jnp.float32)[:, None]
+        return jnp.all(g == want)
+
+    return _all_shards_ok(comms, ok)
+
+
+def test_collective_reducescatter(comms: Comms) -> bool:
+    """Each rank contributes ones(size); each shard gets back size (its slot's sum)."""
+
+    def ok(c: Comms):
+        out = c.reducescatter(jnp.ones((c.size(),)))
+        return jnp.all(out == c.size())
+
+    return _all_shards_ok(comms, ok)
+
+
+def test_pointtopoint_ring(comms: Comms) -> bool:
+    """Ring sendrecv: after one +1 shift every rank holds its left neighbor's
+    rank (ref: test_pointToPoint_simple_send_recv)."""
+
+    def ok(c: Comms):
+        got = c.shift(c.rank().astype(jnp.float32)[None], offset=1)
+        want = (c.rank() - 1) % c.size()
+        return jnp.all(got == want)
+
+    return _all_shards_ok(comms, ok)
+
+
+def test_commsplit(comms: Comms, sub_axis: str) -> bool:
+    """Collectives over a sub-axis only span that axis (ref: test_commsplit)."""
+
+    def ok(c: Comms):
+        sub = c.comm_split(sub_axis)
+        return sub.allreduce(jnp.ones(()), "sum") == sub.size()
+
+    return _all_shards_ok(comms, ok)
+
+
+def run_all(comms: Comms) -> dict:
+    """The perform_test_comms_* battery (raft-dask test_comms.py analogue)."""
+    return {
+        "allreduce": test_collective_allreduce(comms),
+        "broadcast": test_collective_broadcast(comms),
+        "reduce": test_collective_reduce(comms),
+        "allgather": test_collective_allgather(comms),
+        "reducescatter": test_collective_reducescatter(comms),
+        "p2p_ring": test_pointtopoint_ring(comms),
+    }

@@ -1,0 +1,63 @@
+"""BLAS-style dense operations.
+
+Re-design of the reference's cuBLAS wrappers + mdspan free functions
+(cpp/include/raft/linalg/gemm.cuh, gemv.cuh, axpy.cuh, dot.cuh,
+transpose.cuh; detail/cublas_wrappers.hpp). On TPU the "vendor library" is
+the MXU via lax.dot_general with f32 accumulation; alpha/beta epilogues fuse.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["gemm", "gemv", "axpy", "dot", "transpose"]
+
+
+def _mm(a, b):
+    return lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def gemm(a, b, c=None, alpha: float = 1.0, beta: float = 0.0, trans_a: bool = False, trans_b: bool = False):
+    """alpha·op(A)·op(B) + beta·C (reference: linalg/gemm.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    out = alpha * _mm(a, b)
+    if c is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(c)
+    return out.astype(a.dtype)
+
+
+def gemv(a, x, y=None, alpha: float = 1.0, beta: float = 0.0, trans: bool = False):
+    """alpha·op(A)·x + beta·y (reference: linalg/gemv.cuh)."""
+    a = jnp.asarray(a)
+    x = jnp.asarray(x)
+    if trans:
+        a = a.T
+    out = alpha * _mm(a, x[:, None])[:, 0]
+    if y is not None and beta != 0.0:
+        out = out + beta * jnp.asarray(y)
+    return out.astype(a.dtype)
+
+
+def axpy(alpha: float, x, y):
+    """y + alpha·x (reference: linalg/axpy.cuh)."""
+    return jnp.asarray(y) + alpha * jnp.asarray(x)
+
+
+def dot(x, y):
+    """Vector inner product (reference: linalg/dot.cuh)."""
+    return jnp.vdot(jnp.asarray(x), jnp.asarray(y))
+
+
+def transpose(a):
+    """Materialized transpose (reference: linalg/transpose.cuh)."""
+    return jnp.asarray(a).T

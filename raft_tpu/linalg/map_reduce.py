@@ -1,0 +1,170 @@
+"""Elementwise maps, reductions, norms.
+
+Re-design of the reference's map/reduce family (cpp/include/raft/linalg/:
+map.cuh, map_reduce.cuh, unary_op.cuh, binary_op.cuh, ternary_op.cuh,
+add.cuh..divide.cuh, power.cuh, sqrt.cuh, eltwise.cuh, reduce.cuh,
+coalesced_reduction.cuh, strided_reduction.cuh, norm.cuh, normalize.cuh,
+reduce_rows_by_key.cuh, reduce_cols_by_key.cuh, mean_squared_error.cuh,
+matrix_vector_op.cuh). All are XLA-fused jnp compositions; the coalesced-vs-
+strided kernel split dies — XLA picks reduction layouts.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = [
+    "map",
+    "map_reduce",
+    "unary_op",
+    "binary_op",
+    "ternary_op",
+    "eltwise_add",
+    "eltwise_sub",
+    "eltwise_multiply",
+    "eltwise_divide",
+    "power",
+    "sqrt",
+    "reduce",
+    "norm",
+    "normalize",
+    "row_norm",
+    "col_norm",
+    "reduce_rows_by_key",
+    "reduce_cols_by_key",
+    "mean_squared_error",
+    "matrix_vector_op",
+    "NormType",
+]
+
+_builtin_map = map
+
+
+def map(fn, *arrays):  # noqa: A001 (reference name)
+    """Elementwise map over aligned arrays (reference: linalg/map.cuh)."""
+    return fn(*[jnp.asarray(a) for a in arrays])
+
+
+def map_reduce(fn, reduce_fn, *arrays):
+    """Fused map + full reduction (reference: linalg/map_reduce.cuh; the
+    reference's neutral-element argument is implied by ``reduce_fn`` here)."""
+    return reduce_fn(fn(*[jnp.asarray(a) for a in arrays]))
+
+
+unary_op = map
+binary_op = map
+ternary_op = map
+
+
+def eltwise_add(x, y):
+    return jnp.asarray(x) + jnp.asarray(y)
+
+
+def eltwise_sub(x, y):
+    return jnp.asarray(x) - jnp.asarray(y)
+
+
+def eltwise_multiply(x, y):
+    return jnp.asarray(x) * jnp.asarray(y)
+
+
+def eltwise_divide(x, y):
+    return jnp.asarray(x) / jnp.asarray(y)
+
+
+def power(x, p):
+    return jnp.power(jnp.asarray(x), p)
+
+
+def sqrt(x):
+    return jnp.sqrt(jnp.asarray(x))
+
+
+def reduce(m, axis: int = 1, op=jnp.sum, main_op=None, final_op=None):
+    """Generalized row/col reduction with pre/post ops (reference:
+    linalg/reduce.cuh — main_op maps elements, op reduces, final_op maps the
+    result; covers coalesced_reduction/strided_reduction)."""
+    m = jnp.asarray(m)
+    if main_op is not None:
+        m = main_op(m)
+    out = op(m, axis=axis)
+    return final_op(out) if final_op is not None else out
+
+
+class NormType:
+    """Reference: linalg/norm_types.hpp (L1Norm/L2Norm/LinfNorm)."""
+
+    L1 = "l1"
+    L2 = "l2"
+    Linf = "linf"
+
+
+def norm(m, norm_type: str = NormType.L2, axis: int = 1, sqrt: bool = True):
+    """Row/col norms (reference: linalg/norm.cuh rowNorm/colNorm). For L2,
+    ``sqrt=False`` returns squared norms — the reference's default for
+    expanded-distance precomputation."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    if norm_type == NormType.L1:
+        return jnp.sum(jnp.abs(m), axis=axis)
+    if norm_type == NormType.Linf:
+        return jnp.max(jnp.abs(m), axis=axis)
+    expects(norm_type == NormType.L2, "unknown norm type %s", norm_type)
+    sq = jnp.sum(m * m, axis=axis)
+    return jnp.sqrt(sq) if sqrt else sq
+
+
+def row_norm(m, norm_type=NormType.L2, sqrt=True):
+    return norm(m, norm_type, axis=1, sqrt=sqrt)
+
+
+def col_norm(m, norm_type=NormType.L2, sqrt=True):
+    return norm(m, norm_type, axis=0, sqrt=sqrt)
+
+
+def normalize(m, norm_type: str = NormType.L2, eps: float = 1e-10):
+    """Row-normalize (reference: linalg/normalize.cuh)."""
+    n = norm(m, norm_type, axis=1, sqrt=True)
+    return jnp.asarray(m) / jnp.maximum(n, eps)[:, None]
+
+
+def reduce_rows_by_key(m, keys, n_keys: int, weights=None):
+    """Segment-sum rows into per-key accumulators (reference:
+    linalg/reduce_rows_by_key.cuh — the k-means centroid update primitive).
+    On TPU this is one one-hot matmul: (n_keys, m)·(m, d) rides the MXU."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    keys = jnp.asarray(keys)
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=jnp.float32, axis=0)  # (n_keys, m)
+    if weights is not None:
+        onehot = onehot * jnp.asarray(weights)[None, :]
+    return onehot @ m
+
+
+def reduce_cols_by_key(m, keys, n_keys: int):
+    """Sum columns sharing a key (reference: linalg/reduce_cols_by_key.cuh)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    keys = jnp.asarray(keys)
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=jnp.float32)  # (n_cols, n_keys)
+    return m @ onehot
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    """Reference: linalg/mean_squared_error.cuh."""
+    a = jnp.asarray(a).astype(jnp.float32)
+    b = jnp.asarray(b).astype(jnp.float32)
+    return weight * jnp.mean(jnp.square(a - b))
+
+
+def matrix_vector_op(m, vec, op, along_rows: bool = True):
+    """Broadcast a vector against matrix lines (reference:
+    linalg/matrix_vector_op.cuh). ``along_rows=True`` applies vec[j] to
+    column j of every row."""
+    m = jnp.asarray(m)
+    vec = jnp.asarray(vec)
+    if along_rows:
+        expects(vec.shape[0] == m.shape[1], "vector must have len n_cols")
+        return op(m, vec[None, :])
+    expects(vec.shape[0] == m.shape[0], "vector must have len n_rows")
+    return op(m, vec[:, None])

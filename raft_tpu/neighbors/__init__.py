@@ -1,1 +1,11 @@
-"""raft_tpu.neighbors — raft/neighbors (N1-N10). Under construction."""
+"""raft_tpu.neighbors — ANN indexes: brute-force, refine; IVF-Flat, IVF-PQ,
+CAGRA, ball cover follow.
+
+Reference: cpp/include/raft/neighbors/ (L4, N1-N10).
+"""
+
+from . import brute_force
+from .brute_force import BruteForce, knn, knn_merge_parts
+from .refine import refine
+
+__all__ = ["brute_force", "BruteForce", "knn", "knn_merge_parts", "refine"]

@@ -1,0 +1,346 @@
+"""IVF-Flat: inverted-file index over raw vectors.
+
+Re-design of the reference's IVF-Flat (cpp/include/raft/neighbors/ivf_flat-inl.cuh;
+build detail/ivf_flat_build.cuh — balanced-kmeans coarse quantizer, interleaved
+list groups :86,135-153; search detail/ivf_flat_search-inl.cuh:130 — coarse GEMM
++ select_k, fused interleaved scan). The TPU re-think:
+
+- **List layout**: the reference interleaves vectors in groups of 32 for
+  coalesced warp reads; the TPU analogue is a dense padded (n_lists, capacity,
+  d) array — capacity is the max list size rounded to the f32 sublane tile (8),
+  balanced k-means keeps the padding overhead small, and every scan is a
+  contiguous block DMA.
+- **Search**: coarse scoring is one MXU GEMM + select_k (same two-stage shape
+  as the reference); the list scan gathers each query's probed lists and
+  scores them with an einsum that contracts d on the MXU, tiled over
+  (query-tile, probe-chunk) under lax.map so the gathered block stays inside
+  the workspace budget. Stored-vector norms are precomputed at build, so L2
+  scores are ‖v‖² - 2·q·v — no recomputation per query.
+- **Static shapes**: probes, capacity, k are all static; padding slots carry
+  +inf scores and id -1, and can never win select_k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..cluster import kmeans_balanced
+from ..cluster.kmeans_balanced import KMeansBalancedParams
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
+from ..distance.fused_nn import _fused_l2_nn
+from ..distance.pairwise import _choose_tile
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import _select_k
+
+__all__ = ["IndexParams", "SearchParams", "IvfFlatIndex", "build", "extend", "search", "save", "load"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Reference: ivf_flat::index_params (neighbors/ivf_flat_types.hpp)."""
+
+    n_lists: int = 1024
+    metric: str | DistanceType = "sqeuclidean"
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    add_data_on_build: bool = True
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """Reference: ivf_flat::search_params (neighbors/ivf_flat_types.hpp)."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class IvfFlatIndex:
+    """Reference: ivf_flat::index (neighbors/ivf_flat_types.hpp:224)."""
+
+    centers: jax.Array  # (n_lists, d) f32
+    list_data: jax.Array  # (n_lists, capacity, d)
+    list_ids: jax.Array  # (n_lists, capacity) int32, -1 = padding
+    list_norms: jax.Array  # (n_lists, capacity) f32, +inf on padding
+    list_sizes: jax.Array  # (n_lists,) int32
+    metric: DistanceType
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    def tree_flatten(self):
+        return (
+            (self.centers, self.list_data, self.list_ids, self.list_norms, self.list_sizes),
+            self.metric,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, metric, children):
+        return cls(*children, metric=metric)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "capacity"))
+def _fill_lists(x, ids, labels, n_lists: int, capacity: int):
+    """Scatter vectors into padded lists (ref: ivf_flat_build.cuh:160
+    process-and-fill; one vectorized scatter instead of per-vector atomics)."""
+    n, d = x.shape
+    # position of each vector within its list = rank among same-label rows,
+    # via one stable argsort (O(n log n), no (n, n_lists) intermediate)
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = jnp.take(labels, order)
+    counts = jnp.bincount(labels, length=n_lists)
+    starts = jnp.cumsum(counts) - counts
+    pos_sorted = jnp.arange(n, dtype=jnp.int32) - jnp.take(starts, sorted_labels).astype(jnp.int32)
+    pos = jnp.zeros((n,), jnp.int32).at[order].set(pos_sorted)
+    data = jnp.zeros((n_lists, capacity, d), x.dtype)
+    idbuf = jnp.full((n_lists, capacity), -1, jnp.int32)
+    norms = jnp.full((n_lists, capacity), jnp.inf, jnp.float32)
+    data = data.at[labels, pos].set(x)
+    idbuf = idbuf.at[labels, pos].set(ids.astype(jnp.int32))
+    xf = x.astype(jnp.float32)
+    norms = norms.at[labels, pos].set(jnp.sum(xf * xf, axis=1))
+    return data, idbuf, norms, counts.astype(jnp.int32)
+
+
+def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlatIndex:
+    """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh;
+    coarse centers via balanced k-means on a training subsample, then fill)."""
+    res = res or default_resources()
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, d)")
+    n, d = x.shape
+    expects(params.n_lists <= n, "n_lists > n_samples")
+    mt = resolve_metric(params.metric)
+    expects(
+        mt
+        in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.L2Unexpanded,
+            DistanceType.L2SqrtUnexpanded,
+            DistanceType.InnerProduct,
+        ),
+        "ivf_flat supports L2 / inner_product metrics, got %s",
+        mt.name,
+    )
+
+    max_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
+    train_metric = "inner_product" if mt == DistanceType.InnerProduct else "sqeuclidean"
+    kb = KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
+        max_train_points=min(max_train, n),
+    )
+    centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
+
+    if not params.add_data_on_build:
+        cap = 8
+        empty = IvfFlatIndex(
+            centers=centers,
+            list_data=jnp.zeros((params.n_lists, cap, d), x.dtype),
+            list_ids=jnp.full((params.n_lists, cap), -1, jnp.int32),
+            list_norms=jnp.full((params.n_lists, cap), jnp.inf, jnp.float32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=mt,
+        )
+        return empty
+
+    return extend(
+        IvfFlatIndex(
+            centers=centers,
+            list_data=jnp.zeros((params.n_lists, 0, d), x.dtype),
+            list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
+            list_norms=jnp.zeros((params.n_lists, 0), jnp.float32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=mt,
+        ),
+        x,
+        jnp.arange(n, dtype=jnp.int32),
+        res=res,
+    )
+
+
+def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None = None) -> IvfFlatIndex:
+    """Append vectors (reference: ivf_flat::extend, ivf_flat-inl.cuh:160,287).
+
+    Capacity is data-dependent, so extend re-packs lists host-orchestrated:
+    existing + new vectors are re-scattered into a freshly sized padded array
+    (the reference reallocates lists too — ivf_list.hpp resize)."""
+    res = res or default_resources()
+    x = jnp.asarray(new_vectors)
+    expects(x.ndim == 2 and x.shape[1] == index.dim, "vector dim mismatch")
+    n_new = x.shape[0]
+    if new_ids is None:
+        new_ids = index.size + jnp.arange(n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+
+    tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
+    _, labels = _fused_l2_nn(x, index.centers, False, tile)
+
+    # merge with existing list contents (flatten old lists back to rows)
+    if index.capacity > 0 and index.size > 0:
+        old_mask = index.list_ids.reshape(-1) >= 0
+        old_x = index.list_data.reshape(-1, index.dim)[old_mask]
+        old_ids = index.list_ids.reshape(-1)[old_mask]
+        old_labels = jnp.repeat(jnp.arange(index.n_lists), index.capacity)[old_mask]
+        x = jnp.concatenate([old_x, x])
+        new_ids = jnp.concatenate([old_ids, new_ids])
+        labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
+
+    sizes = jnp.bincount(labels, length=index.n_lists)
+    capacity = _round_up(max(int(jnp.max(sizes)), 1), 8)
+    data, idbuf, norms, sizes = _fill_lists(x, new_ids, labels, index.n_lists, capacity)
+    return IvfFlatIndex(index.centers, data, idbuf, norms, sizes, index.metric)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric")
+)
+def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
+                query_tile: int, probe_chunk: int, metric: DistanceType):
+    m, d = queries.shape
+    qf = queries.astype(jnp.float32)
+    inner = metric == DistanceType.InnerProduct
+
+    # ---- stage 1: coarse scoring (ref: ivf_flat_search-inl.cuh:130) ----
+    cscore = qf @ index.centers.T  # (m, L) MXU
+    if not inner:
+        cn = jnp.sum(index.centers * index.centers, axis=1)
+        cscore = cn[None, :] - 2.0 * cscore
+    _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+
+    # pad queries to tile multiple
+    num = -(-m // query_tile)
+    pad = num * query_tile - m
+    qp = jnp.pad(qf, ((0, pad), (0, 0))) if pad else qf
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qt = qp.reshape(num, query_tile, d)
+    pt = pp.reshape(num, query_tile, n_probes)
+
+    n_chunks = n_probes // probe_chunk
+    cap = index.capacity
+
+    def per_tile(args):
+        q, pr = args  # (T, d), (T, p)
+
+        def per_chunk(c, _):
+            pc = lax.dynamic_slice_in_dim(pr, c * probe_chunk, probe_chunk, axis=1)  # (T, pc)
+            vecs = index.list_data[pc]  # (T, pc, cap, d) gather
+            ids = index.list_ids[pc]  # (T, pc, cap)
+            dots = jnp.einsum(
+                "td,tpcd->tpc", q, vecs.astype(jnp.float32),
+                precision=lax.Precision.HIGHEST,
+            )
+            if inner:
+                scores = jnp.where(ids >= 0, dots, -jnp.inf)
+            else:
+                norms = index.list_norms[pc]
+                scores = norms - 2.0 * dots  # +inf padding stays +inf
+            flat_s = scores.reshape(query_tile, probe_chunk * cap)
+            flat_i = ids.reshape(query_tile, probe_chunk * cap)
+            return c + 1, _select_k(flat_s, flat_i, k, not inner)
+
+        _, (cv, ci) = lax.scan(per_chunk, 0, None, length=n_chunks)
+        # (chunks, T, k) → per-query merge
+        cv = jnp.moveaxis(cv, 0, 1).reshape(query_tile, n_chunks * k)
+        ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
+        return _select_k(cv, ci, k, not inner)
+
+    dists, idx = lax.map(per_tile, (qt, pt))
+    dists = dists.reshape(num * query_tile, k)[:m]
+    idx = idx.reshape(num * query_tile, k)[:m]
+    if not inner:
+        # convert ‖v‖²-2qv partial scores to true squared L2 by adding ‖q‖²
+        qn = jnp.sum(qf * qf, axis=1, keepdims=True)
+        dists = jnp.where(jnp.isfinite(dists), jnp.maximum(dists + qn, 0.0), dists)
+        if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+            dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(dists), dists)
+    return dists, idx
+
+
+def search(params: SearchParams, index: IvfFlatIndex, queries, k: int, res: Resources | None = None):
+    """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh;
+    pylibraft neighbors/ivf_flat search). Returns (distances (m,k), ids (m,k));
+    id -1 marks slots beyond the probed candidate count."""
+    res = res or default_resources()
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
+    expects(index.capacity > 0 and index.size > 0, "index is empty")
+    n_probes = min(params.n_probes, index.n_lists)
+    m = queries.shape[0]
+    expects(
+        k <= n_probes * index.capacity,
+        "k=%d exceeds the probed candidate pool (n_probes=%d x capacity=%d)",
+        k, n_probes, index.capacity,
+    )
+
+    # chunk probes so the gathered (tile, chunk, cap, d) block fits the budget,
+    # while each chunk still holds >= k candidates for the per-chunk select
+    min_chunk = -(-k // index.capacity)
+    probe_chunk = n_probes
+    query_tile = min(m, 256)
+    while probe_chunk // 2 >= min_chunk and probe_chunk % 2 == 0 and (
+        query_tile * probe_chunk * index.capacity * index.dim * 4 > res.workspace_bytes
+    ):
+        probe_chunk //= 2
+    while query_tile > 8 and query_tile * probe_chunk * index.capacity * index.dim * 4 > res.workspace_bytes:
+        query_tile //= 2
+    # n_probes must divide into chunks
+    while n_probes % probe_chunk:
+        probe_chunk -= 1
+    probe_chunk = max(probe_chunk, min_chunk)
+    while n_probes % probe_chunk:
+        probe_chunk += 1
+
+    return _ivf_search(index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric)
+
+
+def save(index: IvfFlatIndex, path: str) -> None:
+    """Serialize (reference: ivf_flat_serialize.cuh; pylibraft save)."""
+    with open(path, "wb") as f:
+        serialize_scalar(f, "ivf_flat")
+        serialize_scalar(f, int(index.metric))
+        serialize_mdspan(f, index.centers)
+        serialize_mdspan(f, index.list_data)
+        serialize_mdspan(f, index.list_ids)
+        serialize_mdspan(f, index.list_norms)
+        serialize_mdspan(f, index.list_sizes)
+
+
+def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
+    """Deserialize (reference: ivf_flat_serialize.cuh deserialize)."""
+    with open(path, "rb") as f:
+        tag = deserialize_scalar(f)
+        expects(tag == "ivf_flat", "not an ivf_flat index file (tag=%s)", tag)
+        metric = DistanceType(deserialize_scalar(f))
+        centers = jnp.asarray(deserialize_mdspan(f))
+        data = jnp.asarray(deserialize_mdspan(f))
+        ids = jnp.asarray(deserialize_mdspan(f))
+        norms = jnp.asarray(deserialize_mdspan(f))
+        sizes = jnp.asarray(deserialize_mdspan(f))
+    return IvfFlatIndex(centers, data, ids, norms, sizes, metric)

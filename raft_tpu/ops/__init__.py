@@ -1,1 +1,3 @@
-"""raft_tpu.ops — Pallas TPU kernels backing hot paths. Under construction."""
+"""raft_tpu.ops — Pallas TPU kernels backing hot paths (select_k variants,
+IVF scan fusions). Population grows as profiling identifies XLA-composition
+bottlenecks; modules land here with benchmarks."""

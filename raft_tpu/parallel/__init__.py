@@ -1,1 +1,11 @@
-"""raft_tpu.parallel — distributed algorithm drivers over raft_tpu.comms. Under construction."""
+"""raft_tpu.parallel — distributed algorithm drivers over raft_tpu.comms.
+
+The reference ships the communicator and leaves distributed algorithms to
+consumers (cuML/cuGraph over raft::comms, docs/source/using_comms.rst); here
+the canonical ones are in-tree: sharded exact kNN with global merge, and
+multi-chip k-means.
+"""
+
+from . import kmeans, knn
+
+__all__ = ["knn", "kmeans"]

@@ -1,0 +1,88 @@
+"""Multi-chip k-means: the cuML-over-raft::comms pattern, TPU-native.
+
+The reference keeps MNMG k-means in cuML, built on raft::comms collectives
+(SURVEY.md §3.E note): each worker assigns its shard and allreduces per-center
+sums/counts. Here the whole distributed Lloyd loop is ONE jitted shard_map
+program — assignment is the per-shard fused-1-NN GEMM, the update is a psum
+over ICI, and the while_loop runs on-device with no host round trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..cluster.kmeans import KMeansOutput, KMeansParams, _kmeans_plus_plus
+from ..comms.comms import Comms, replicated, shard_along
+from ..core.errors import expects
+from ..distance.fused_nn import _fused_l2_nn
+
+__all__ = ["fit", "predict"]
+
+
+def fit(comms: Comms, params: KMeansParams, x, tile: int = 4096) -> KMeansOutput:
+    """Distributed Lloyd (same contract as cluster.kmeans.fit, data sharded
+    along ``comms.axis``). Init = k-means++ on a cross-shard subsample: each
+    chip contributes random rows, the pooled candidates are allgathered
+    (identical on every chip), and ++ runs replicated — no serialized
+    global D² sampling over the full dataset."""
+    x = jnp.asarray(x)
+    n, d = x.shape
+    size = comms.size()
+    expects(n % size == 0, "dataset rows must divide the mesh axis; pad first")
+    k = params.n_clusters
+    shard_rows = n // size
+    sub = min(max(8 * k, 64), shard_rows)
+
+    def step(x_shard, key):
+        # per-shard distinct subsample → pooled ++ seeding
+        ksub = jax.random.fold_in(key[0], comms.rank())
+        idx = jax.random.choice(ksub, shard_rows, (sub,), replace=False)
+        pool = comms.allgather(jnp.take(x_shard, idx, axis=0), tiled=True)  # (size*sub, d)
+        init_c = _kmeans_plus_plus(pool.astype(jnp.float32), key[1], k, tile)
+
+        def cond(state):
+            _, shift2, it = state
+            return jnp.logical_and(it < params.max_iter, shift2 > params.tol**2)
+
+        def body(state):
+            centers, _, it = state
+            _, labels = _fused_l2_nn(x_shard, centers, False, min(tile, x_shard.shape[0]))
+            onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)
+            sums = comms.allreduce(onehot @ x_shard.astype(jnp.float32), "sum")
+            counts = comms.allreduce(jnp.sum(onehot, axis=1), "sum")
+            new_centers = jnp.where(
+                counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
+            )
+            return new_centers, jnp.sum(jnp.square(new_centers - centers)), it + 1
+
+        centers, _, n_iter = lax.while_loop(cond, body, (init_c, jnp.inf, 0))
+        d2, labels = _fused_l2_nn(x_shard, centers, False, min(tile, x_shard.shape[0]))
+        inertia = comms.allreduce(jnp.sum(d2), "sum")
+        return centers, labels, inertia, n_iter
+
+    x_sharded = shard_along(comms.mesh, comms.axis, x)
+    key = replicated(comms.mesh, jax.random.split(jax.random.key(params.seed), 2))
+    fn = comms.shard_map(step, in_specs=(P(comms.axis), P()),
+                         out_specs=(P(), P(comms.axis), P(), P()))
+    centers, labels, inertia, n_iter = jax.jit(fn)(x_sharded, key)
+    return KMeansOutput(centers, labels, inertia, int(n_iter))
+
+
+def predict(comms: Comms, x, centroids, tile: int = 4096):
+    """Distributed assignment; labels come back sharded like ``x``."""
+    x = jnp.asarray(x)
+    centroids = jnp.asarray(centroids)
+
+    def step(x_shard, c):
+        d2, labels = _fused_l2_nn(x_shard, c, False, min(tile, x_shard.shape[0]))
+        return labels, comms.allreduce(jnp.sum(d2), "sum")
+
+    x_sharded = shard_along(comms.mesh, comms.axis, x)
+    c_repl = replicated(comms.mesh, centroids)
+    fn = comms.shard_map(step, in_specs=(P(comms.axis), P()), out_specs=(P(comms.axis), P()))
+    return jax.jit(fn)(x_sharded, c_repl)

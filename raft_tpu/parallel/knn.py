@@ -1,0 +1,64 @@
+"""Multi-chip exact kNN: shard the dataset, search locally, merge globally.
+
+The reference leaves multi-GPU kNN to users composing raft::comms + per-shard
+search + knn_merge_parts (SURVEY.md §5 "long-context" entry;
+docs/source/using_comms.rst). Here it is a first-class driver: the dataset is
+row-sharded over a mesh axis, every chip runs the tiled brute-force search on
+its shard (MXU GEMM + fused top-k), and one all_gather + select_k merge
+produces the global result — candidates ride ICI, never the full distance
+matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comms.comms import Comms, replicated, shard_along
+from ..core.errors import expects
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import _select_k
+from ..neighbors.brute_force import _bf_knn
+
+__all__ = ["knn"]
+
+
+def knn(comms: Comms, dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
+        tile: int = 2048, inner_tile: int = 512):
+    """Distributed exact kNN (multi-chip analogue of brute_force.knn).
+
+    ``dataset`` is sharded along ``comms.axis`` (row-wise, equal shards —
+    pad the tail shard like the reference pads inverted lists); ``queries``
+    are replicated. Returns replicated (distances (m, k), global indices).
+    """
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    n = dataset.shape[0]
+    size = comms.size()
+    expects(n % size == 0, "dataset rows (%d) must divide the mesh axis (%d); pad first", n, size)
+    shard_rows = n // size
+    expects(0 < k <= shard_rows, "k must be <= per-shard rows")
+    mt = resolve_metric(metric)
+    select_min = mt != DistanceType.InnerProduct
+
+    def step(x_shard, q):
+        # local exact search on this chip's rows
+        d_loc, i_loc = _bf_knn(x_shard, q, k, mt, metric_arg,
+                               min(tile, q.shape[0]), inner_tile)
+        # shard-local → global ids
+        i_glob = i_loc + comms.rank().astype(jnp.int32) * shard_rows
+        # candidates ride ICI: (size, m, k) each
+        d_all = comms.allgather(d_loc)
+        i_all = comms.allgather(i_glob)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, select_min)
+
+    x_sharded = shard_along(comms.mesh, comms.axis, dataset)
+    q_repl = replicated(comms.mesh, queries)
+    fn = comms.shard_map(step, in_specs=(P(comms.axis), P()), out_specs=(P(), P()))
+    return jax.jit(fn)(x_sharded, q_repl)

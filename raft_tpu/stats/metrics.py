@@ -1,0 +1,255 @@
+"""Model/clustering evaluation metrics.
+
+Re-design of the reference's stats metric kernels (cpp/include/raft/stats/:
+accuracy.cuh, r2_score.cuh, regression_metrics.cuh, entropy.cuh,
+mutual_info_score.cuh, rand_index.cuh, adjusted_rand_index.cuh,
+homogeneity_score.cuh, completeness_score.cuh, v_measure.cuh,
+kl_divergence.cuh, silhouette_score.cuh, trustworthiness_score.cuh,
+dispersion.cuh, contingency_matrix.cuh, information_criterion.cuh). The
+contingency matrix — the hub all cluster-comparison metrics route through —
+is a one-hot GEMM on TPU; everything downstream is small dense math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+from ..distance.pairwise import pairwise_distance
+
+__all__ = [
+    "accuracy",
+    "r2_score",
+    "regression_metrics",
+    "entropy",
+    "contingency_matrix",
+    "mutual_info_score",
+    "rand_index",
+    "adjusted_rand_index",
+    "homogeneity_score",
+    "completeness_score",
+    "v_measure",
+    "kl_divergence",
+    "silhouette_score",
+    "dispersion",
+    "trustworthiness",
+    "information_criterion",
+]
+
+_f32 = jnp.float32
+
+
+def accuracy(predictions, labels):
+    """Fraction of exact matches (reference: stats/accuracy.cuh)."""
+    p = jnp.asarray(predictions)
+    l = jnp.asarray(labels)
+    return jnp.mean((p == l).astype(_f32))
+
+
+def r2_score(y, y_hat):
+    """Coefficient of determination (reference: stats/r2_score.cuh)."""
+    y = jnp.asarray(y).astype(_f32)
+    y_hat = jnp.asarray(y_hat).astype(_f32)
+    ss_res = jnp.sum(jnp.square(y - y_hat))
+    ss_tot = jnp.sum(jnp.square(y - jnp.mean(y)))
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref):
+    """(mean_abs_error, mean_squared_error, median_abs_error) (reference:
+    stats/regression_metrics.cuh)."""
+    p = jnp.asarray(predictions).astype(_f32)
+    r = jnp.asarray(ref).astype(_f32)
+    err = p - r
+    return jnp.mean(jnp.abs(err)), jnp.mean(jnp.square(err)), jnp.median(jnp.abs(err))
+
+
+def _class_counts(labels, n_classes: int):
+    return jnp.sum(jax.nn.one_hot(jnp.asarray(labels), n_classes, dtype=_f32), axis=0)
+
+
+def entropy(labels, n_classes: int):
+    """Shannon entropy of a label distribution, in nats (reference:
+    stats/entropy.cuh)."""
+    counts = _class_counts(labels, n_classes)
+    p = counts / jnp.sum(counts)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def contingency_matrix(a, b, n_classes_a: int | None = None, n_classes_b: int | None = None):
+    """Joint label-count matrix via one-hot GEMM (reference:
+    stats/contingency_matrix.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    na = int(n_classes_a if n_classes_a is not None else int(jnp.max(a)) + 1)
+    nb = int(n_classes_b if n_classes_b is not None else int(jnp.max(b)) + 1)
+    oa = jax.nn.one_hot(a, na, dtype=_f32)  # (n, na)
+    ob = jax.nn.one_hot(b, nb, dtype=_f32)
+    return (oa.T @ ob).astype(jnp.int32)
+
+
+def _mi_from_contingency(c):
+    c = c.astype(_f32)
+    n = jnp.sum(c)
+    pij = c / n
+    pi = jnp.sum(pij, axis=1, keepdims=True)
+    pj = jnp.sum(pij, axis=0, keepdims=True)
+    logterm = jnp.where(pij > 0, jnp.log(jnp.where(pij > 0, pij, 1.0)) - jnp.log(pi * pj + 1e-30), 0.0)
+    return jnp.sum(pij * logterm)
+
+
+def mutual_info_score(a, b, n_classes: int):
+    """Reference: stats/mutual_info_score.cuh."""
+    return _mi_from_contingency(contingency_matrix(a, b, n_classes, n_classes).astype(_f32))
+
+
+def rand_index(a, b):
+    """Unadjusted Rand index (reference: stats/rand_index.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    na = int(jnp.max(a)) + 1
+    nb = int(jnp.max(b)) + 1
+    c = contingency_matrix(a, b, na, nb).astype(_f32)
+    n = jnp.sum(c)
+    sum_sq = jnp.sum(jnp.square(c))
+    sum_rows_sq = jnp.sum(jnp.square(jnp.sum(c, axis=1)))
+    sum_cols_sq = jnp.sum(jnp.square(jnp.sum(c, axis=0)))
+    # pairs: agreements = C(n,2) - [ (Σrows² - Σc²)/2 + (Σcols² - Σc²)/2 ]... use standard identity
+    comb = lambda x: x * (x - 1.0) / 2.0
+    a_pairs = jnp.sum(comb(c))
+    row_pairs = comb(jnp.sum(c, axis=1)).sum()
+    col_pairs = comb(jnp.sum(c, axis=0)).sum()
+    total = comb(n)
+    return (total + 2 * a_pairs - row_pairs - col_pairs) / total
+
+
+def adjusted_rand_index(a, b, n_classes: int | None = None):
+    """ARI (reference: stats/adjusted_rand_index.cuh)."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    na = n_classes or int(jnp.max(a)) + 1
+    nb = n_classes or int(jnp.max(b)) + 1
+    c = contingency_matrix(a, b, na, nb).astype(_f32)
+    comb = lambda x: x * (x - 1.0) / 2.0
+    sum_comb = jnp.sum(comb(c))
+    sum_rows = jnp.sum(comb(jnp.sum(c, axis=1)))
+    sum_cols = jnp.sum(comb(jnp.sum(c, axis=0)))
+    n = jnp.sum(c)
+    expected = sum_rows * sum_cols / comb(n)
+    max_index = 0.5 * (sum_rows + sum_cols)
+    return (sum_comb - expected) / (max_index - expected + 1e-30)
+
+
+def _conditional_entropy(c):
+    """H(A|B) from contingency counts c[a, b]."""
+    c = c.astype(_f32)
+    n = jnp.sum(c)
+    pj = jnp.sum(c, axis=0)  # counts of b
+    ratio = c / jnp.maximum(pj[None, :], 1e-30)
+    term = jnp.where(c > 0, (c / n) * jnp.log(jnp.where(ratio > 0, ratio, 1.0)), 0.0)
+    return -jnp.sum(term)
+
+
+def homogeneity_score(labels_true, labels_pred, n_classes: int):
+    """1 - H(C|K)/H(C) (reference: stats/homogeneity_score.cuh)."""
+    c = contingency_matrix(labels_true, labels_pred, n_classes, n_classes)
+    h_c = entropy(labels_true, n_classes)
+    h_ck = _conditional_entropy(c)
+    return jnp.where(h_c > 0, 1.0 - h_ck / jnp.maximum(h_c, 1e-30), 1.0)
+
+
+def completeness_score(labels_true, labels_pred, n_classes: int):
+    """Reference: stats/completeness_score.cuh."""
+    return homogeneity_score(labels_pred, labels_true, n_classes)
+
+
+def v_measure(labels_true, labels_pred, n_classes: int, beta: float = 1.0):
+    """Harmonic mean of homogeneity and completeness (reference:
+    stats/v_measure.cuh)."""
+    h = homogeneity_score(labels_true, labels_pred, n_classes)
+    c = completeness_score(labels_true, labels_pred, n_classes)
+    return jnp.where(h + c > 0, (1 + beta) * h * c / (beta * h + c + 1e-30), 0.0)
+
+
+def kl_divergence(p, q):
+    """Σ p log(p/q) over two densities (reference: stats/kl_divergence.cuh)."""
+    p = jnp.asarray(p).astype(_f32)
+    q = jnp.asarray(q).astype(_f32)
+    return jnp.sum(jnp.where(p > 0, p * (jnp.log(jnp.where(p > 0, p, 1.0)) - jnp.log(jnp.maximum(q, 1e-30))), 0.0))
+
+
+def silhouette_score(x, labels, n_classes: int, metric="euclidean"):
+    """Mean silhouette coefficient (reference: stats/silhouette_score.cuh,
+    batched variant stats/detail/batched/silhouette_score.cuh).
+
+    Per-cluster distance sums come from one (n, n)·(n, k) GEMM against the
+    one-hot label matrix — the TPU shape of the reference's per-sample
+    accumulations.
+    """
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels)
+    d = pairwise_distance(x, x, metric=metric)  # (n, n)
+    onehot = jax.nn.one_hot(labels, n_classes, dtype=_f32)  # (n, k)
+    sums = d @ onehot  # (n, k): distance mass from i to each cluster
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+    own_count = counts[labels]
+    own_sum = jnp.take_along_axis(sums, labels[:, None], axis=1)[:, 0]
+    a = jnp.where(own_count > 1, own_sum / jnp.maximum(own_count - 1, 1), 0.0)
+    other_mean = jnp.where(
+        (counts[None, :] > 0) & (jax.nn.one_hot(labels, n_classes) == 0),
+        sums / jnp.maximum(counts[None, :], 1),
+        jnp.inf,
+    )
+    b = jnp.min(other_mean, axis=1)
+    s = jnp.where(own_count > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return jnp.mean(s)
+
+
+def dispersion(centroids, cluster_sizes, global_centroid=None):
+    """Weighted scatter of centroids around the global mean (reference:
+    stats/dispersion.cuh)."""
+    c = jnp.asarray(centroids).astype(_f32)
+    sizes = jnp.asarray(cluster_sizes).astype(_f32)
+    if global_centroid is None:
+        global_centroid = jnp.sum(c * sizes[:, None], axis=0) / jnp.sum(sizes)
+    sq = jnp.sum(jnp.square(c - global_centroid[None, :]), axis=1)
+    return jnp.sqrt(jnp.sum(sizes * sq))
+
+
+def trustworthiness(x, x_embedded, n_neighbors: int, metric="euclidean"):
+    """Embedding-quality score (reference:
+    stats/trustworthiness_score.cuh): penalizes points that are kNN in the
+    embedding but far in the original space."""
+    x = jnp.asarray(x)
+    e = jnp.asarray(x_embedded)
+    n = x.shape[0]
+    k = n_neighbors
+    expects(k < n / 2, "n_neighbors must be < n/2")
+    d_orig = pairwise_distance(x, x, metric=metric)
+    d_emb = pairwise_distance(e, e, metric=metric)
+    big = jnp.finfo(_f32).max
+    eye_mask = jnp.eye(n, dtype=bool)
+    d_orig = jnp.where(eye_mask, big, d_orig)
+    d_emb = jnp.where(eye_mask, big, d_emb)
+    # rank of j in i's original-space ordering (0 = nearest)
+    orig_order = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jax.vmap(lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32)))(ranks, orig_order)
+    emb_knn = jnp.argsort(d_emb, axis=1)[:, :k]
+    r = jnp.take_along_axis(ranks, emb_knn, axis=1).astype(_f32)  # (n, k)
+    penalty = jnp.sum(jnp.maximum(r - (k - 1), 0.0))
+    norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
+    return 1.0 - norm * penalty
+
+
+def information_criterion(log_likelihood, n_params: int, n_samples: int, kind: str = "bic"):
+    """AIC/AICc/BIC (reference: stats/information_criterion.cuh)."""
+    ll = jnp.asarray(log_likelihood).astype(_f32)
+    if kind == "aic":
+        return -2.0 * ll + 2.0 * n_params
+    if kind == "aicc":
+        corr = 2.0 * n_params * (n_params + 1.0) / jnp.maximum(n_samples - n_params - 1.0, 1.0)
+        return -2.0 * ll + 2.0 * n_params + corr
+    expects(kind == "bic", "kind must be aic|aicc|bic")
+    return -2.0 * ll + n_params * jnp.log(jnp.asarray(float(n_samples)))

@@ -1,0 +1,114 @@
+"""Summary statistics over dense matrices.
+
+Re-design of the reference's stats moment kernels (cpp/include/raft/stats/:
+mean.cuh, stddev.cuh, meanvar.cuh, cov.cuh, sum.cuh, minmax.cuh,
+histogram.cuh (shared-mem binning), weighted_mean.cuh, mean_center.cuh).
+Everything is an XLA reduction/GEMM; the histogram's shared-memory binning
+strategy becomes a one-hot matmul that rides the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import expects
+
+__all__ = [
+    "mean",
+    "stddev",
+    "vars_",
+    "meanvar",
+    "cov",
+    "sum_",
+    "minmax",
+    "histogram",
+    "weighted_mean",
+    "mean_center",
+    "mean_add",
+]
+
+
+def mean(m, axis: int = 0, sample: bool = False):
+    """Column means (reference: stats/mean.cuh; ``sample`` divides by n-1)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    n = m.shape[axis]
+    s = jnp.sum(m, axis=axis)
+    return s / (n - 1 if sample else n)
+
+
+def vars_(m, mu=None, axis: int = 0, sample: bool = True):
+    """Column variances (reference: stats/vars.cuh)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    if mu is None:
+        mu = jnp.mean(m, axis=axis)
+    n = m.shape[axis]
+    sq = jnp.sum(jnp.square(m - jnp.expand_dims(mu, axis)), axis=axis)
+    return sq / (n - 1 if sample else n)
+
+
+def stddev(m, mu=None, axis: int = 0, sample: bool = True):
+    """Reference: stats/stddev.cuh."""
+    return jnp.sqrt(vars_(m, mu, axis, sample))
+
+
+def meanvar(m, axis: int = 0, sample: bool = True):
+    """Fused mean+variance (reference: stats/meanvar.cuh)."""
+    mu = mean(m, axis)
+    return mu, vars_(m, mu, axis, sample)
+
+
+def cov(m, sample: bool = True):
+    """Covariance of columns (reference: stats/cov.cuh — gemm on centered
+    data)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    c = m - jnp.mean(m, axis=0, keepdims=True)
+    n = m.shape[0]
+    return (c.T @ c) / (n - 1 if sample else n)
+
+
+def sum_(m, axis: int = 0):
+    """Reference: stats/sum.cuh."""
+    return jnp.sum(jnp.asarray(m).astype(jnp.float32), axis=axis)
+
+
+def minmax(m, axis: int = 0):
+    """Per-column (min, max) (reference: stats/minmax.cuh)."""
+    m = jnp.asarray(m)
+    return jnp.min(m, axis=axis), jnp.max(m, axis=axis)
+
+
+def histogram(m, n_bins: int, lower: float, upper: float):
+    """Per-column fixed-width histogram (reference: stats/histogram.cuh).
+
+    Bin index = floor((x - lower)/width) clipped to [0, n_bins); counts are a
+    one-hot matmul so the binning rides the MXU instead of shared-mem atomics.
+    Returns (n_bins, n_cols) int32 counts.
+    """
+    m = jnp.asarray(m).astype(jnp.float32)
+    expects(upper > lower, "upper must exceed lower")
+    width = (upper - lower) / n_bins
+    idx = jnp.clip(jnp.floor((m - lower) / width), 0, n_bins - 1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(idx, n_bins, dtype=jnp.float32, axis=0)  # (n_bins, n_rows, n_cols)
+    return jnp.sum(onehot, axis=1).astype(jnp.int32)
+
+
+def weighted_mean(m, weights, axis: int = 0):
+    """Weighted column means (reference: stats/weighted_mean.cuh)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    w = jnp.asarray(weights).astype(jnp.float32)
+    w_exp = jnp.expand_dims(w, 1 - axis) if m.ndim == 2 else w
+    return jnp.sum(m * w_exp, axis=axis) / jnp.sum(w)
+
+
+def mean_center(m, mu=None, axis: int = 0):
+    """Subtract means (reference: stats/mean_center.cuh)."""
+    m = jnp.asarray(m).astype(jnp.float32)
+    if mu is None:
+        mu = jnp.mean(m, axis=axis)
+    return m - jnp.expand_dims(mu, axis)
+
+
+def mean_add(m, mu, axis: int = 0):
+    """Add means back (reference: stats/mean_center.cuh meanAdd)."""
+    return jnp.asarray(m).astype(jnp.float32) + jnp.expand_dims(jnp.asarray(mu), axis)

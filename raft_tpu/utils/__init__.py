@@ -1,1 +1,2 @@
-"""raft_tpu.utils — misc helpers (ref: raft/util residue). Under construction."""
+"""raft_tpu.utils — small helpers (reference: raft/util residue; most of
+that toolkit — warp primitives, vectorized IO, Pow2 — dissolves into XLA)."""

@@ -1,0 +1,127 @@
+"""Brute-force kNN + refine tests (reference analogue:
+cpp/test/neighbors/tiled_knn.cu, knn.cu; refine via cpp/test/neighbors/refine.cu)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as sp_dist
+
+from raft_tpu.core import RaftError, Resources
+from raft_tpu.neighbors import BruteForce, knn, knn_merge_parts, refine
+
+
+def _exact(x, q, k, metric="sqeuclidean"):
+    d = sp_dist.cdist(q, x, metric)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, 1), idx
+
+
+class TestKnn:
+    def test_matches_exact(self, rng):
+        x = rng.random((500, 16)).astype(np.float32)
+        q = rng.random((40, 16)).astype(np.float32)
+        dists, idx = knn(x, q, k=8)
+        wd, wi = _exact(x, q, 8)
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+        # indices may differ on ties; check distances of chosen ids instead
+        chosen = sp_dist.cdist(q, x, "sqeuclidean")
+        np.testing.assert_allclose(
+            np.take_along_axis(chosen, np.asarray(idx), 1), wd, atol=1e-3, rtol=1e-4
+        )
+
+    def test_euclidean_metric(self, rng):
+        x = rng.random((200, 8)).astype(np.float32)
+        q = rng.random((10, 8)).astype(np.float32)
+        dists, _ = knn(x, q, k=4, metric="euclidean")
+        wd, _ = _exact(x, q, 4, "euclidean")
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+
+    def test_inner_product_selects_max(self, rng):
+        x = rng.random((100, 8)).astype(np.float32)
+        q = rng.random((5, 8)).astype(np.float32)
+        dists, idx = knn(x, q, k=3, metric="inner_product")
+        full = q @ x.T
+        want = np.sort(full, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(np.asarray(dists), want, rtol=1e-4)
+
+    def test_tiny_workspace_tiling(self, rng):
+        x = rng.random((300, 12)).astype(np.float32)
+        q = rng.random((77, 12)).astype(np.float32)
+        res = Resources(workspace_bytes=300 * 14 * 4 * 8)
+        dists, idx = knn(x, q, k=5, res=res)
+        wd, _ = _exact(x, q, 5)
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+
+    def test_l1_metric_path(self, rng):
+        x = rng.random((150, 6)).astype(np.float32)
+        q = rng.random((9, 6)).astype(np.float32)
+        dists, idx = knn(x, q, k=4, metric="l1")
+        wd, _ = _exact(x, q, 4, "cityblock")
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+
+    def test_index_class(self, rng):
+        x = rng.random((80, 4)).astype(np.float32)
+        q = rng.random((6, 4)).astype(np.float32)
+        idx = BruteForce().build(x)
+        d1, i1 = idx.search(q, 3)
+        d2, i2 = knn(x, q, 3)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_unbuilt_index_raises(self):
+        with pytest.raises(RaftError, match="not built"):
+            BruteForce().search(np.zeros((2, 3), np.float32), 1)
+
+    def test_k_too_big(self, rng):
+        with pytest.raises(RaftError):
+            knn(np.zeros((5, 2), np.float32), np.zeros((1, 2), np.float32), 6)
+
+
+class TestMergeParts:
+    def test_merge_equals_global(self, rng):
+        """Sharded kNN + merge must equal unsharded kNN — the multi-chip
+        correctness property (ref: knn_merge_parts use at knn_brute_force.cuh:490)."""
+        x = rng.random((400, 8)).astype(np.float32)
+        q = rng.random((20, 8)).astype(np.float32)
+        k = 6
+        shards = np.split(np.arange(400), 4)
+        pd, pi = [], []
+        for s in shards:
+            d, i = knn(x[s], q, k)
+            pd.append(np.asarray(d))
+            pi.append(np.asarray(i) + s[0])  # shard-local → global ids
+        md, mi = knn_merge_parts(np.stack(pd), np.stack(pi))
+        gd, gi = knn(x, q, k)
+        np.testing.assert_allclose(np.asarray(md), np.asarray(gd), atol=1e-5)
+        np.testing.assert_array_equal(np.sort(np.asarray(mi), 1), np.sort(np.asarray(gi), 1))
+
+
+class TestRefine:
+    def test_refine_improves_candidates(self, rng):
+        x = rng.random((300, 10)).astype(np.float32)
+        q = rng.random((15, 10)).astype(np.float32)
+        # candidates: the true top-20 shuffled
+        _, cand = _exact(x, q, 20)
+        perm = rng.permutation(20)
+        cand_shuffled = cand[:, perm]
+        dists, ids = refine(x, q, cand_shuffled, k=5)
+        wd, wi = _exact(x, q, 5)
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+        np.testing.assert_array_equal(np.sort(np.asarray(ids), 1), np.sort(wi, 1))
+
+    def test_refine_with_padding(self, rng):
+        x = rng.random((50, 4)).astype(np.float32)
+        q = rng.random((3, 4)).astype(np.float32)
+        cand = np.array([[0, 1, -1, 2], [3, -1, -1, 4], [5, 6, 7, -1]], np.int32)
+        dists, ids = refine(x, q, cand, k=3)
+        ids = np.asarray(ids)
+        # padding never outranks real candidates
+        assert (ids[1, :2] >= 0).all()
+        assert ids[1, 2] == -1
+        assert np.isinf(np.asarray(dists)[1, 2])
+
+    def test_refine_sqrt_metric(self, rng):
+        x = rng.random((60, 5)).astype(np.float32)
+        q = rng.random((4, 5)).astype(np.float32)
+        _, cand = _exact(x, q, 10)
+        dists, _ = refine(x, q, cand, k=4, metric="euclidean")
+        wd, _ = _exact(x, q, 4, "euclidean")
+        np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)

@@ -1,0 +1,150 @@
+"""IVF-Flat tests — recall-threshold acceptance vs brute force, the
+reference's ANN test strategy (cpp/test/neighbors/ann_ivf_flat.cuh;
+python test_ivf_flat via pylibraft)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as sp_dist
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.random import make_blobs
+
+
+def _recall(got_ids, true_ids):
+    hits = 0
+    for g, t in zip(got_ids, true_ids):
+        hits += len(set(g.tolist()) & set(t.tolist()))
+    return hits / true_ids.size
+
+
+@pytest.fixture(scope="module")
+def data():
+    x, _ = make_blobs(5000, 32, n_clusters=50, cluster_std=2.0, seed=0)
+    q, _ = make_blobs(100, 32, n_clusters=50, cluster_std=2.0, seed=1)
+    return np.asarray(x), np.asarray(q)
+
+
+class TestBuild:
+    def test_index_structure(self, data):
+        x, _ = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0), x)
+        assert idx.n_lists == 64
+        assert idx.dim == 32
+        assert idx.size == 5000
+        sizes = np.asarray(idx.list_sizes)
+        assert sizes.sum() == 5000
+        assert sizes.min() > 0  # balanced kmeans must not leave empty lists
+        # every real slot has a valid id; padding is -1
+        ids = np.asarray(idx.list_ids)
+        for l in range(64):
+            assert (ids[l, : sizes[l]] >= 0).all()
+            assert (ids[l, sizes[l]:] == -1).all()
+
+    def test_ids_are_permutation(self, data):
+        x, _ = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=32), x)
+        ids = np.asarray(idx.list_ids)
+        real = ids[ids >= 0]
+        assert sorted(real.tolist()) == list(range(5000))
+
+    def test_list_contents_match_dataset(self, data):
+        x, _ = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16), x)
+        ids = np.asarray(idx.list_ids)
+        dat = np.asarray(idx.list_data)
+        l, s = 3, 0
+        for s in range(int(np.asarray(idx.list_sizes)[l])):
+            np.testing.assert_allclose(dat[l, s], x[ids[l, s]], rtol=1e-6)
+
+
+class TestSearch:
+    def test_high_probe_recall(self, data):
+        """All lists probed → exact search (recall 1)."""
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=32, seed=0), x)
+        d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), idx, q, k=10)
+        true_d = sp_dist.cdist(q, x, "sqeuclidean")
+        true_i = np.argsort(true_d, 1)[:, :10]
+        assert _recall(np.asarray(i), true_i) > 0.999
+        np.testing.assert_allclose(
+            np.sort(np.asarray(d), 1), np.sort(np.take_along_axis(true_d, true_i, 1), 1),
+            atol=1e-2, rtol=1e-3,
+        )
+
+    def test_partial_probe_recall(self, data):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0), x)
+        _, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), idx, q, k=10)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        rec = _recall(np.asarray(i), true_i)
+        assert rec > 0.9, rec
+
+    def test_recall_grows_with_probes(self, data):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0), x)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        recalls = []
+        for p in (1, 4, 16, 64):
+            _, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=p), idx, q, k=10)
+            recalls.append(_recall(np.asarray(i), true_i))
+        assert recalls == sorted(recalls), recalls
+        assert recalls[-1] > 0.999
+
+    def test_inner_product_metric(self, data):
+        x, q = data
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=32, metric="inner_product", seed=0), x
+        )
+        _, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), idx, q, k=5)
+        true_i = np.argsort(-(q @ x.T), 1)[:, :5]
+        assert _recall(np.asarray(i), true_i) > 0.95
+
+    def test_sqrt_metric_values(self, data):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, metric="euclidean"), x)
+        d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), idx, q, k=5)
+        got = np.asarray(d)[:, 0]
+        want = sp_dist.cdist(q, x, "euclidean").min(1)
+        np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+class TestExtend:
+    def test_extend_adds_vectors(self, data):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=32, seed=0), x[:4000])
+        idx = ivf_flat.extend(idx, x[4000:], np.arange(4000, 5000, dtype=np.int32))
+        assert idx.size == 5000
+        _, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), idx, q, k=10)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        assert _recall(np.asarray(i), true_i) > 0.999
+
+    def test_build_without_data_then_extend(self, data):
+        x, q = data
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, add_data_on_build=False, seed=0), x
+        )
+        assert idx.size == 0
+        idx = ivf_flat.extend(idx, x, np.arange(5000, dtype=np.int32))
+        assert idx.size == 5000
+
+
+class TestSerialize:
+    def test_roundtrip(self, tmp_path, data):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+        path = str(tmp_path / "index.bin")
+        ivf_flat.save(idx, path)
+        idx2 = ivf_flat.load(path)
+        d1, i1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx, q, k=5)
+        d2, i2 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx2, q, k=5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+    def test_wrong_file_tag(self, tmp_path):
+        from raft_tpu.core import RaftError, serialize_scalar
+
+        path = str(tmp_path / "bad.bin")
+        with open(path, "wb") as f:
+            serialize_scalar(f, "ivf_pq")
+        with pytest.raises(RaftError, match="not an ivf_flat"):
+            ivf_flat.load(path)

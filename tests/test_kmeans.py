@@ -1,0 +1,109 @@
+"""k-means tests (reference analogue: cpp/test/cluster/kmeans*.cu,
+python/pylibraft/pylibraft/test/test_kmeans.py)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import KMeansBalancedParams, KMeansParams, kmeans, kmeans_balanced
+from raft_tpu.core import RaftError
+from raft_tpu.random import make_blobs
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    x, labels = make_blobs(1500, 10, n_clusters=5, cluster_std=0.3, seed=7)
+    return np.asarray(x), np.asarray(labels)
+
+
+class TestKMeans:
+    def test_fit_recovers_blobs(self, blobs):
+        x, true_labels = blobs
+        out = kmeans.fit(KMeansParams(n_clusters=5, seed=1), x)
+        assert out.centroids.shape == (5, 10)
+        # compare partitions via ARI
+        from sklearn.metrics import adjusted_rand_score
+
+        ari = adjusted_rand_score(true_labels, np.asarray(out.labels))
+        assert ari > 0.95, ari
+
+    def test_inertia_decreases_vs_random_centroids(self, blobs):
+        x, _ = blobs
+        out = kmeans.fit(KMeansParams(n_clusters=5, seed=0), x)
+        rand_cost = float(kmeans.cluster_cost(x, x[:5]))
+        assert float(out.inertia) < rand_cost
+
+    def test_predict_matches_fit_labels(self, blobs):
+        x, _ = blobs
+        out = kmeans.fit(KMeansParams(n_clusters=5, seed=0), x)
+        labels, inertia = kmeans.predict(x, out.centroids)
+        np.testing.assert_array_equal(np.asarray(labels), np.asarray(out.labels))
+        np.testing.assert_allclose(float(inertia), float(out.inertia), rtol=1e-5)
+
+    def test_transform_shape(self, blobs):
+        x, _ = blobs
+        out = kmeans.fit(KMeansParams(n_clusters=5, seed=0), x)
+        t = kmeans.transform(x[:50], out.centroids)
+        assert t.shape == (50, 5)
+        np.testing.assert_array_equal(np.asarray(t).argmin(1), np.asarray(out.labels)[:50])
+
+    def test_random_init(self, blobs):
+        x, true_labels = blobs
+        # random init is a weaker seeding — it may land in a local optimum,
+        # so only require a decent partition across restarts
+        out = kmeans.fit(KMeansParams(n_clusters=5, init="random", seed=3, n_init=5), x)
+        from sklearn.metrics import adjusted_rand_score
+
+        assert adjusted_rand_score(true_labels, np.asarray(out.labels)) > 0.6
+
+    def test_array_init(self, blobs):
+        x, _ = blobs
+        init = x[:5].copy()
+        out = kmeans.fit(KMeansParams(n_clusters=5, init="array"), x, centroids=init)
+        assert float(out.inertia) > 0
+
+    def test_weighted_fit(self, blobs):
+        x, _ = blobs
+        w = np.ones(len(x), np.float32)
+        out = kmeans.fit(KMeansParams(n_clusters=5, seed=0), x, sample_weights=w)
+        out_unw = kmeans.fit(KMeansParams(n_clusters=5, seed=0), x)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(out.centroids), 0),
+            np.sort(np.asarray(out_unw.centroids), 0),
+            atol=1e-3,
+        )
+
+    def test_too_many_clusters_raises(self):
+        with pytest.raises(RaftError):
+            kmeans.fit(KMeansParams(n_clusters=10), np.zeros((5, 2), np.float32))
+
+    def test_find_k(self):
+        x, _ = make_blobs(600, 4, n_clusters=3, cluster_std=0.2, seed=11)
+        best_k, scores = kmeans.find_k(np.asarray(x), k_range=[2, 3, 5, 8])
+        assert best_k == 3, scores
+
+
+class TestKMeansBalanced:
+    def test_clusters_are_balanced(self):
+        x, _ = make_blobs(2000, 8, n_clusters=4, cluster_std=2.0, seed=5)
+        centers, labels, sizes = kmeans_balanced.build_clusters(
+            KMeansBalancedParams(n_iters=15, seed=2), np.asarray(x), 16
+        )
+        sizes = np.asarray(sizes)
+        assert sizes.sum() == 2000
+        assert sizes.min() > 0, sizes  # no empty lists — the IVF requirement
+        assert sizes.max() / max(sizes.mean(), 1) < 4.0, sizes
+
+    def test_predict_consistency(self):
+        x, _ = make_blobs(500, 6, n_clusters=3, cluster_std=0.3, seed=9)
+        x = np.asarray(x)
+        centers = kmeans_balanced.fit(KMeansBalancedParams(n_iters=10), x, 8)
+        labels = np.asarray(kmeans_balanced.predict(x, centers))
+        d = ((x[:, None, :] - np.asarray(centers)[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d.argmin(1))
+
+    def test_subsampled_training(self):
+        x, _ = make_blobs(3000, 5, n_clusters=4, seed=4)
+        params = KMeansBalancedParams(n_iters=10, max_train_points=500)
+        centers = kmeans_balanced.fit(params, np.asarray(x), 8)
+        assert centers.shape == (8, 5)
+        assert np.isfinite(np.asarray(centers)).all()

@@ -1,0 +1,152 @@
+"""Linalg tests (reference analogue: cpp/test/linalg/*, LINALG_TEST)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+
+class TestBlas:
+    def test_gemm(self, rng):
+        a = rng.random((5, 7)).astype(np.float32)
+        b = rng.random((7, 3)).astype(np.float32)
+        c = rng.random((5, 3)).astype(np.float32)
+        out = np.asarray(linalg.gemm(a, b, c, alpha=2.0, beta=0.5))
+        np.testing.assert_allclose(out, 2 * a @ b + 0.5 * c, rtol=1e-5)
+
+    def test_gemm_transpose(self, rng):
+        a = rng.random((7, 5)).astype(np.float32)
+        b = rng.random((3, 7)).astype(np.float32)
+        out = np.asarray(linalg.gemm(a, b, trans_a=True, trans_b=True))
+        np.testing.assert_allclose(out, a.T @ b.T, rtol=1e-5)
+
+    def test_gemv(self, rng):
+        a = rng.random((4, 6)).astype(np.float32)
+        x = rng.random(6).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.gemv(a, x)), a @ x, rtol=1e-5)
+
+    def test_axpy_dot(self, rng):
+        x = rng.random(9).astype(np.float32)
+        y = rng.random(9).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.axpy(2.0, x, y)), y + 2 * x, rtol=1e-6)
+        np.testing.assert_allclose(float(linalg.dot(x, y)), x @ y, rtol=1e-5)
+
+
+class TestMapReduce:
+    def test_norms(self, rng):
+        m = rng.standard_normal((6, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(m)), np.linalg.norm(m, axis=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm(m, sqrt=False)), (m**2).sum(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.col_norm(m, linalg.NormType.L1)), np.abs(m).sum(0), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(m, linalg.NormType.Linf, axis=1)), np.abs(m).max(1), rtol=1e-6
+        )
+
+    def test_normalize(self, rng):
+        m = rng.standard_normal((5, 6)).astype(np.float32)
+        out = np.asarray(linalg.normalize(m))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+    def test_reduce_custom(self, rng):
+        m = rng.random((4, 5)).astype(np.float32)
+        out = np.asarray(linalg.reduce(m, axis=1, main_op=jnp.square, final_op=jnp.sqrt))
+        np.testing.assert_allclose(out, np.linalg.norm(m, axis=1), rtol=1e-5)
+
+    def test_reduce_rows_by_key(self, rng):
+        m = rng.random((10, 4)).astype(np.float32)
+        keys = rng.integers(0, 3, 10)
+        out = np.asarray(linalg.reduce_rows_by_key(m, keys, 3))
+        want = np.zeros((3, 4), np.float32)
+        for i, k in enumerate(keys):
+            want[k] += m[i]
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_reduce_rows_by_key_weighted(self, rng):
+        m = rng.random((10, 4)).astype(np.float32)
+        keys = rng.integers(0, 3, 10)
+        w = rng.random(10).astype(np.float32)
+        out = np.asarray(linalg.reduce_rows_by_key(m, keys, 3, weights=w))
+        want = np.zeros((3, 4), np.float32)
+        for i, k in enumerate(keys):
+            want[k] += w[i] * m[i]
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng):
+        m = rng.random((4, 10)).astype(np.float32)
+        keys = rng.integers(0, 3, 10)
+        out = np.asarray(linalg.reduce_cols_by_key(m, keys, 3))
+        want = np.zeros((4, 3), np.float32)
+        for j, k in enumerate(keys):
+            want[:, k] += m[:, j]
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_mse(self, rng):
+        a = rng.random(20).astype(np.float32)
+        b = rng.random(20).astype(np.float32)
+        np.testing.assert_allclose(
+            float(linalg.mean_squared_error(a, b)), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+
+    def test_matrix_vector_op(self, rng):
+        m = rng.random((3, 5)).astype(np.float32)
+        v = rng.random(5).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.matrix_vector_op(m, v, jnp.multiply)), m * v[None, :], rtol=1e-6
+        )
+
+
+class TestSolvers:
+    def test_eigh(self, rng):
+        a = rng.standard_normal((8, 8)).astype(np.float32)
+        a = a @ a.T + 8 * np.eye(8, dtype=np.float32)
+        w, v = linalg.eigh(a)
+        w, v = np.asarray(w), np.asarray(v)
+        np.testing.assert_allclose(a @ v, v * w[None, :], atol=1e-3)
+        assert (np.diff(w) >= -1e-4).all()  # ascending
+
+    def test_qr(self, rng):
+        a = rng.standard_normal((10, 4)).astype(np.float32)
+        q, r = linalg.qr(a)
+        q, r = np.asarray(q), np.asarray(r)
+        np.testing.assert_allclose(q @ r, a, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-4)
+
+    def test_svd(self, rng):
+        a = rng.standard_normal((8, 5)).astype(np.float32)
+        u, s, vt = linalg.svd(a)
+        np.testing.assert_allclose(
+            np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(vt), a, atol=1e-4
+        )
+
+    def test_rsvd_recovers_low_rank(self, rng):
+        # exact low-rank matrix: rsvd must recover the spectrum
+        u = rng.standard_normal((60, 4)).astype(np.float32)
+        v = rng.standard_normal((4, 30)).astype(np.float32)
+        a = u @ v
+        _, s_full, _ = np.linalg.svd(a)
+        uu, s, vvt = linalg.rsvd(a, k=4, p=8, n_iter=3)
+        np.testing.assert_allclose(np.asarray(s), s_full[:4], rtol=1e-3)
+        approx = np.asarray(uu) @ np.diag(np.asarray(s)) @ np.asarray(vvt)
+        np.testing.assert_allclose(approx, a, atol=1e-2)
+
+    def test_lstsq(self, rng):
+        a = rng.standard_normal((30, 5)).astype(np.float32)
+        w = rng.standard_normal(5).astype(np.float32)
+        b = a @ w
+        got = np.asarray(linalg.lstsq(a, b))
+        np.testing.assert_allclose(got, w, atol=1e-3)
+
+    def test_cholesky_r1_update(self, rng):
+        a = rng.standard_normal((6, 6)).astype(np.float32)
+        a = a @ a.T + 6 * np.eye(6, dtype=np.float32)
+        x = rng.standard_normal(6).astype(np.float32)
+        l = np.linalg.cholesky(a)
+        l_up = np.asarray(linalg.cholesky_r1_update(l, x))
+        np.testing.assert_allclose(l_up @ l_up.T, a + np.outer(x, x), atol=1e-3)

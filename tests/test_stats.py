@@ -1,0 +1,159 @@
+"""Stats tests vs numpy/sklearn (reference analogue: cpp/test/stats/*, STATS_TEST)."""
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from raft_tpu import stats
+
+
+class TestMoments:
+    def test_mean_stddev(self, rng):
+        m = rng.standard_normal((50, 6)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.mean(m)), m.mean(0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(stats.stddev(m)), m.std(0, ddof=1), rtol=1e-4)
+
+    def test_meanvar(self, rng):
+        m = rng.standard_normal((50, 6)).astype(np.float32)
+        mu, var = stats.meanvar(m)
+        np.testing.assert_allclose(np.asarray(var), m.var(0, ddof=1), rtol=1e-4)
+
+    def test_cov(self, rng):
+        m = rng.standard_normal((100, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.cov(m)), np.cov(m.T), rtol=1e-3, atol=1e-4)
+
+    def test_minmax_sum(self, rng):
+        m = rng.standard_normal((20, 3)).astype(np.float32)
+        lo, hi = stats.minmax(m)
+        np.testing.assert_array_equal(np.asarray(lo), m.min(0))
+        np.testing.assert_array_equal(np.asarray(hi), m.max(0))
+        np.testing.assert_allclose(np.asarray(stats.sum_(m)), m.sum(0), rtol=1e-4, atol=1e-5)
+
+    def test_histogram(self, rng):
+        m = rng.random((200, 2)).astype(np.float32)
+        h = np.asarray(stats.histogram(m, n_bins=10, lower=0.0, upper=1.0))
+        assert h.shape == (10, 2)
+        assert h.sum(0).tolist() == [200, 200]
+        want0 = np.histogram(m[:, 0], bins=10, range=(0, 1))[0]
+        np.testing.assert_array_equal(h[:, 0], want0)
+
+    def test_weighted_mean(self, rng):
+        m = rng.random((30, 4)).astype(np.float32)
+        w = rng.random(30).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.weighted_mean(m, w)), np.average(m, axis=0, weights=w), rtol=1e-4
+        )
+
+    def test_mean_center_roundtrip(self, rng):
+        m = rng.random((10, 4)).astype(np.float32)
+        mu = m.mean(0)
+        c = stats.mean_center(m)
+        np.testing.assert_allclose(np.asarray(c).mean(0), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(stats.mean_add(c, mu)), m, atol=1e-5)
+
+
+class TestClassification:
+    def test_accuracy(self):
+        assert float(stats.accuracy([1, 2, 3, 4], [1, 2, 0, 4])) == pytest.approx(0.75)
+
+    def test_r2(self, rng):
+        y = rng.random(50)
+        yh = y + 0.1 * rng.standard_normal(50)
+        np.testing.assert_allclose(float(stats.r2_score(y, yh)), skm.r2_score(y, yh), atol=1e-4)
+
+    def test_regression_metrics(self, rng):
+        p = rng.random(40)
+        r = rng.random(40)
+        mae, mse, medae = stats.regression_metrics(p, r)
+        np.testing.assert_allclose(float(mae), np.abs(p - r).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(mse), ((p - r) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(medae), np.median(np.abs(p - r)), rtol=1e-5)
+
+
+class TestClusterMetrics:
+    def setup_method(self, _):
+        r = np.random.default_rng(0)
+        self.a = r.integers(0, 4, 200)
+        self.b = np.where(r.random(200) < 0.8, self.a, r.integers(0, 4, 200))
+
+    def test_contingency(self):
+        c = np.asarray(stats.contingency_matrix(self.a, self.b, 4, 4))
+        assert c.sum() == 200
+        want = skm.cluster.contingency_matrix(self.a, self.b)
+        np.testing.assert_array_equal(c, want)
+
+    def test_entropy(self):
+        got = float(stats.entropy(self.a, 4))
+        p = np.bincount(self.a, minlength=4) / 200
+        want = -(p[p > 0] * np.log(p[p > 0])).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mutual_info(self):
+        np.testing.assert_allclose(
+            float(stats.mutual_info_score(self.a, self.b, 4)),
+            skm.mutual_info_score(self.a, self.b),
+            atol=1e-5,
+        )
+
+    def test_rand_index(self):
+        # unadjusted RI vs sklearn's pair_confusion-based value
+        from sklearn.metrics.cluster import pair_confusion_matrix
+
+        pc = pair_confusion_matrix(self.a, self.b)
+        want = (pc[0, 0] + pc[1, 1]) / pc.sum()
+        np.testing.assert_allclose(float(stats.rand_index(self.a, self.b)), want, atol=1e-5)
+
+    def test_ari(self):
+        np.testing.assert_allclose(
+            float(stats.adjusted_rand_index(self.a, self.b)),
+            skm.adjusted_rand_score(self.a, self.b),
+            atol=1e-5,
+        )
+
+    def test_homogeneity_completeness_v(self):
+        h, c, v = (
+            float(stats.homogeneity_score(self.a, self.b, 4)),
+            float(stats.completeness_score(self.a, self.b, 4)),
+            float(stats.v_measure(self.a, self.b, 4)),
+        )
+        hs, cs, vs = skm.homogeneity_completeness_v_measure(self.a, self.b)
+        np.testing.assert_allclose([h, c, v], [hs, cs, vs], atol=1e-4)
+
+    def test_silhouette(self, rng):
+        from raft_tpu.random import make_blobs
+
+        x, labels = make_blobs(300, 5, n_clusters=3, cluster_std=0.5, seed=3)
+        x, labels = np.asarray(x), np.asarray(labels)
+        got = float(stats.silhouette_score(x, labels, 3))
+        want = skm.silhouette_score(x, labels)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+
+    def test_kl_divergence(self, rng):
+        p = rng.random(20)
+        p /= p.sum()
+        q = rng.random(20)
+        q /= q.sum()
+        want = (p * np.log(p / q)).sum()
+        np.testing.assert_allclose(float(stats.kl_divergence(p, q)), want, rtol=1e-4)
+
+    def test_trustworthiness(self, rng):
+        from sklearn.manifold import trustworthiness as sk_trust
+
+        x = rng.standard_normal((60, 8)).astype(np.float32)
+        e = x[:, :2] + 0.01 * rng.standard_normal((60, 2)).astype(np.float32)
+        got = float(stats.trustworthiness(x, e, n_neighbors=5))
+        want = sk_trust(x, e, n_neighbors=5)
+        np.testing.assert_allclose(got, want, atol=1e-2)
+
+    def test_dispersion(self):
+        centroids = np.array([[0.0, 0.0], [2.0, 0.0]], np.float32)
+        sizes = np.array([10, 10], np.float32)
+        # global centroid (1,0); each centroid at squared distance 1 → sqrt(20)
+        np.testing.assert_allclose(float(stats.dispersion(centroids, sizes)), np.sqrt(20), rtol=1e-5)
+
+    def test_information_criterion(self):
+        ll = -100.0
+        np.testing.assert_allclose(float(stats.information_criterion(ll, 5, 50, "aic")), 210.0)
+        np.testing.assert_allclose(
+            float(stats.information_criterion(ll, 5, 50, "bic")), 200 + 5 * np.log(50), rtol=1e-6
+        )
