@@ -1,11 +1,19 @@
-"""raft_tpu.neighbors — ANN indexes: brute-force, refine; IVF-Flat, IVF-PQ,
-CAGRA, ball cover follow.
+"""raft_tpu.neighbors — ANN indexes.
 
 Reference: cpp/include/raft/neighbors/ (L4, N1-N10).
 """
 
-from . import brute_force
+from . import brute_force, cagra, ivf_flat, ivf_pq
 from .brute_force import BruteForce, knn, knn_merge_parts
 from .refine import refine
 
-__all__ = ["brute_force", "BruteForce", "knn", "knn_merge_parts", "refine"]
+__all__ = [
+    "brute_force",
+    "cagra",
+    "ivf_flat",
+    "ivf_pq",
+    "BruteForce",
+    "knn",
+    "knn_merge_parts",
+    "refine",
+]

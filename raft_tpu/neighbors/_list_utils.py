@@ -1,0 +1,62 @@
+"""Shared inverted-list machinery for IVF indexes.
+
+The reference factors this as ivf::list (neighbors/ivf_list.hpp) shared by
+IVF-Flat and IVF-PQ; same idea here: within-list position assignment for the
+padded scatter, and the search-time (query_tile, probe_chunk) sizing plan.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["round_up", "list_positions", "plan_search_tiles"]
+
+
+def round_up(x: int, mult: int) -> int:
+    return -(-x // mult) * mult
+
+
+def list_positions(labels, n_lists: int):
+    """Within-list position of each row = its rank among same-label rows,
+    via one stable argsort (no (n, n_lists) intermediate).
+
+    Returns (pos (n,) int32, counts (n_lists,) int32).
+    """
+    n = labels.shape[0]
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = jnp.take(labels, order)
+    counts = jnp.bincount(labels, length=n_lists)
+    starts = jnp.cumsum(counts) - counts
+    pos_sorted = jnp.arange(n, dtype=jnp.int32) - jnp.take(starts, sorted_labels).astype(jnp.int32)
+    pos = jnp.zeros((n,), jnp.int32).at[order].set(pos_sorted)
+    return pos, counts.astype(jnp.int32)
+
+
+def plan_search_tiles(m: int, n_probes: int, k: int, capacity: int,
+                      bytes_per_probe_row: int, budget_bytes: int,
+                      max_query_tile: int = 256):
+    """Pick (query_tile, probe_chunk) so the per-step gather block fits the
+    workspace budget while every chunk still holds >= k candidates — the
+    shared analogue of the reference's memory-aware tile sizing
+    (knn_brute_force.cuh:78 applied to list scans).
+
+    ``bytes_per_probe_row``: bytes a single (query, probe) pair contributes
+    (list payload + LUT etc.).
+    """
+    min_chunk = -(-k // capacity)
+    probe_chunk = n_probes
+    query_tile = min(m, max_query_tile)
+
+    def cost(qt, pc):
+        return qt * pc * bytes_per_probe_row
+
+    while probe_chunk // 2 >= min_chunk and probe_chunk % 2 == 0 and cost(query_tile, probe_chunk) > budget_bytes:
+        probe_chunk //= 2
+    while query_tile > 8 and cost(query_tile, probe_chunk) > budget_bytes:
+        query_tile //= 2
+    while n_probes % probe_chunk:
+        probe_chunk -= 1
+    probe_chunk = max(probe_chunk, min_chunk)
+    while n_probes % probe_chunk:
+        probe_chunk += 1
+    return query_tile, probe_chunk

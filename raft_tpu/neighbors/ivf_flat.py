@@ -38,8 +38,19 @@ from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
+from ._list_utils import list_positions, plan_search_tiles, round_up
 
 __all__ = ["IndexParams", "SearchParams", "IvfFlatIndex", "build", "extend", "search", "save", "load"]
+
+
+def _assign_to_lists(x, centers, metric: DistanceType, tile: int):
+    """List assignment consistent with the index metric (the reference uses
+    kmeans_balanced::predict with the index metric so storage placement and
+    search probing agree)."""
+    if metric == DistanceType.InnerProduct:
+        scores = jnp.asarray(x).astype(jnp.float32) @ jnp.asarray(centers).T
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+    return _fused_l2_nn(x, centers, False, tile)[1]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,23 +111,12 @@ class IvfFlatIndex:
         return cls(*children, metric=metric)
 
 
-def _round_up(x: int, mult: int) -> int:
-    return -(-x // mult) * mult
-
-
 @functools.partial(jax.jit, static_argnames=("n_lists", "capacity"))
 def _fill_lists(x, ids, labels, n_lists: int, capacity: int):
     """Scatter vectors into padded lists (ref: ivf_flat_build.cuh:160
     process-and-fill; one vectorized scatter instead of per-vector atomics)."""
     n, d = x.shape
-    # position of each vector within its list = rank among same-label rows,
-    # via one stable argsort (O(n log n), no (n, n_lists) intermediate)
-    order = jnp.argsort(labels, stable=True)
-    sorted_labels = jnp.take(labels, order)
-    counts = jnp.bincount(labels, length=n_lists)
-    starts = jnp.cumsum(counts) - counts
-    pos_sorted = jnp.arange(n, dtype=jnp.int32) - jnp.take(starts, sorted_labels).astype(jnp.int32)
-    pos = jnp.zeros((n,), jnp.int32).at[order].set(pos_sorted)
+    pos, counts = list_positions(labels, n_lists)
     data = jnp.zeros((n_lists, capacity, d), x.dtype)
     idbuf = jnp.full((n_lists, capacity), -1, jnp.int32)
     norms = jnp.full((n_lists, capacity), jnp.inf, jnp.float32)
@@ -200,7 +200,7 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
-    _, labels = _fused_l2_nn(x, index.centers, False, tile)
+    labels = _assign_to_lists(x, index.centers, index.metric, tile)
 
     # merge with existing list contents (flatten old lists back to rows)
     if index.capacity > 0 and index.size > 0:
@@ -213,7 +213,7 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
         labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
 
     sizes = jnp.bincount(labels, length=index.n_lists)
-    capacity = _round_up(max(int(jnp.max(sizes)), 1), 8)
+    capacity = round_up(max(int(jnp.max(sizes)), 1), 8)
     data, idbuf, norms, sizes = _fill_lists(x, new_ids, labels, index.n_lists, capacity)
     return IvfFlatIndex(index.centers, data, idbuf, norms, sizes, index.metric)
 
@@ -299,23 +299,11 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int, res: Reso
         k, n_probes, index.capacity,
     )
 
-    # chunk probes so the gathered (tile, chunk, cap, d) block fits the budget,
-    # while each chunk still holds >= k candidates for the per-chunk select
-    min_chunk = -(-k // index.capacity)
-    probe_chunk = n_probes
-    query_tile = min(m, 256)
-    while probe_chunk // 2 >= min_chunk and probe_chunk % 2 == 0 and (
-        query_tile * probe_chunk * index.capacity * index.dim * 4 > res.workspace_bytes
-    ):
-        probe_chunk //= 2
-    while query_tile > 8 and query_tile * probe_chunk * index.capacity * index.dim * 4 > res.workspace_bytes:
-        query_tile //= 2
-    # n_probes must divide into chunks
-    while n_probes % probe_chunk:
-        probe_chunk -= 1
-    probe_chunk = max(probe_chunk, min_chunk)
-    while n_probes % probe_chunk:
-        probe_chunk += 1
+    query_tile, probe_chunk = plan_search_tiles(
+        m, n_probes, int(k), index.capacity,
+        bytes_per_probe_row=index.capacity * index.dim * 4,
+        budget_bytes=res.workspace_bytes,
+    )
 
     return _ivf_search(index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric)
 

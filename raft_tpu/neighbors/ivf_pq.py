@@ -1,0 +1,499 @@
+"""IVF-PQ: inverted-file index with product-quantized residuals.
+
+Re-design of the reference's IVF-PQ (cpp/include/raft/neighbors/ivf_pq-inl.cuh:
+build :270 / search :723; build detail/ivf_pq_build.cuh — rotation matrix
+make_rotation_matrix :121, residuals select_residuals :165, codebook training
+train_per_subset :343 / train_per_cluster :424; search detail/ivf_pq_search.cuh
+— select_clusters :68, LUT scan ivfpq_search_worker :419, fused top-k; params
+ivf_pq_types.hpp:48-140). The TPU re-think:
+
+- **Rotation**: a (d_rot, d) orthonormal matrix (QR of Gaussian noise, exactly
+  the reference's construction) — one GEMM at build and per query batch.
+- **Codebooks**: PER_SUBSPACE trains one codebook per pq_dim subspace over
+  all residual sub-vectors (vmapped balanced-EM — all subspaces train
+  simultaneously as one batched kmeans, a TPU win over the reference's
+  sequential stream loop); PER_CLUSTER trains per coarse cluster.
+- **Codes**: stored unpacked, one uint8 per (vector, subspace) in padded
+  lists (n_lists, capacity, pq_dim) — trading the reference's bit-packed
+  layout (ivf_pq_codepacking.cuh) for direct gather/byte loads; pq_bits
+  still bounds the codebook size.
+- **Search**: coarse GEMM + select_k, then per (query-tile, probe-chunk):
+  LUT = ‖residual_sub - codebook‖² for every subspace (one batched GEMM
+  against the codebooks), scores = LUT-gather summed over subspaces, fused
+  select_k. The reference's fp8-LUT trick maps to bf16 LUTs (lut_dtype).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..cluster import kmeans_balanced
+from ..cluster.kmeans_balanced import KMeansBalancedParams
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
+from ..distance.fused_nn import _fused_l2_nn
+from ..distance.pairwise import _choose_tile
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import _select_k
+from ..random.rng import as_key
+from ._list_utils import list_positions, plan_search_tiles, round_up
+from .ivf_flat import _assign_to_lists
+
+__all__ = ["IndexParams", "SearchParams", "IvfPqIndex", "build", "extend", "search", "save", "load"]
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Reference: ivf_pq::index_params (ivf_pq_types.hpp:48-105)."""
+
+    n_lists: int = 1024
+    metric: str | DistanceType = "sqeuclidean"
+    pq_bits: int = 8  # codebook size = 2**pq_bits (ref :68, 4..8 supported)
+    pq_dim: int = 0  # 0 → d/2 rounded to a multiple of 8 (ref :81 heuristic)
+    codebook_kind: str = "per_subspace"  # ref :43 codebook_gen
+    force_random_rotation: bool = False  # ref :98
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    add_data_on_build: bool = True
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """Reference: ivf_pq::search_params (ivf_pq_types.hpp:108-140)."""
+
+    n_probes: int = 20
+    lut_dtype: str = "float32"  # "float32" | "bfloat16" (ref lut_dtype :122)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class IvfPqIndex:
+    """Reference: ivf_pq::index (ivf_pq_types.hpp:172-300)."""
+
+    centers: jax.Array  # (n_lists, d) f32 coarse centers
+    centers_rot: jax.Array  # (n_lists, d_rot) f32 — rotated centers
+    rotation: jax.Array  # (d_rot, d) f32 orthonormal
+    codebooks: jax.Array  # per_subspace: (pq_dim, 2**bits, pq_len); per_cluster: (n_lists, 2**bits, pq_len)
+    list_codes: jax.Array  # (n_lists, capacity, pq_dim) uint8
+    list_ids: jax.Array  # (n_lists, capacity) int32, -1 padding
+    list_sizes: jax.Array  # (n_lists,) int32
+    metric: DistanceType = DistanceType.L2Expanded
+    codebook_kind: str = "per_subspace"
+    pq_bits: int = 8
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def rot_dim(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.list_codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.rot_dim // self.pq_dim
+
+    @property
+    def capacity(self) -> int:
+        return self.list_codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    def tree_flatten(self):
+        children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
+                    self.list_codes, self.list_ids, self.list_sizes)
+        return children, (self.metric, self.codebook_kind, self.pq_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2])
+
+
+def _default_pq_dim(d: int) -> int:
+    """Reference heuristic (ivf_pq_types.hpp:81): ~d/2, a multiple of 8."""
+    pq = max(d // 2, 1)
+    if pq >= 8:
+        pq = (pq // 8) * 8
+    return pq
+
+
+def _make_rotation(key, d_rot: int, d: int, force_random: bool):
+    """Reference: make_rotation_matrix (ivf_pq_build.cuh:121) — random
+    orthonormal via QR when forced or when d_rot != d; else identity(-pad)."""
+    if not force_random and d_rot == d:
+        return jnp.eye(d, dtype=jnp.float32)
+    if not force_random:
+        eye = jnp.zeros((d_rot, d), jnp.float32)
+        return eye.at[jnp.arange(min(d_rot, d)), jnp.arange(min(d_rot, d))].set(1.0)
+    g = jax.random.normal(key, (max(d_rot, d), max(d_rot, d)), jnp.float32)
+    q, _ = jnp.linalg.qr(g)
+    return q[:d_rot, :d]
+
+
+@functools.partial(jax.jit, static_argnames=("n_codes", "n_iters"))
+def _train_codebooks_batched(subvecs, key, n_codes: int, n_iters: int):
+    """Train all codebooks simultaneously: subvecs (B, n, pq_len) → codebooks
+    (B, n_codes, pq_len). One vmapped mini-batch EM — every subspace (or
+    cluster) trains in parallel on the MXU (ref: train_per_subset :343 runs a
+    stream loop; TPU batches it instead)."""
+
+    def one(sv, k):
+        n = sv.shape[0]
+        # small pools (n < n_codes) seed with replacement — duplicates split
+        # during EM; matches the reference's tolerance for tiny trainsets
+        init_idx = jax.random.choice(k, n, (n_codes,), replace=n < n_codes)
+        centers = jnp.take(sv, init_idx, axis=0)
+
+        def body(i, c):
+            d2 = (
+                jnp.sum(c * c, axis=1)[None, :]
+                - 2.0 * sv @ c.T
+            )  # (n, n_codes)
+            labels = jnp.argmin(d2, axis=1)
+            onehot = jax.nn.one_hot(labels, n_codes, dtype=jnp.float32, axis=0)
+            sums = onehot @ sv
+            counts = jnp.sum(onehot, axis=1)
+            return jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], c)
+
+        return lax.fori_loop(0, n_iters, body, centers)
+
+    keys = jax.random.split(key, subvecs.shape[0])
+    return jax.vmap(one)(subvecs.astype(jnp.float32), keys)
+
+
+@functools.partial(jax.jit, static_argnames=("per_cluster", "tile"))
+def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
+    """Nearest codebook entry per subspace, as tiled GEMMs.
+
+    residuals_rot: (n, pq_dim, pq_len). codebooks: (pq_dim, K, L) for
+    per_subspace, (n_lists, K, L) for per_cluster (selected via labels).
+    Computes argmin over ‖r‖²-free scores ‖c‖² - 2·r·c (the search-LUT
+    expansion) in row tiles so the (tile, pq_dim, K) block bounds memory.
+    Returns (n, pq_dim) uint8.
+    """
+    n = residuals_rot.shape[0]
+    cb = codebooks.astype(jnp.float32)
+    cb_n2 = jnp.sum(cb * cb, axis=-1)  # (B, K)
+    num = -(-n // tile)
+    pad = num * tile - n
+    r = jnp.pad(residuals_rot, ((0, pad), (0, 0), (0, 0))) if pad else residuals_rot
+    lb = jnp.pad(labels, (0, pad)) if pad else labels
+    rt = r.reshape(num, tile, *residuals_rot.shape[1:])
+    lt = lb.reshape(num, tile)
+
+    def body(args):
+        rb, lbl = args  # (t, pq_dim, L), (t,)
+        if per_cluster:
+            cbl = cb[lbl]  # (t, K, L)
+            dots = jnp.einsum("tsl,tkl->tsk", rb, cbl, precision=lax.Precision.HIGHEST)
+            d2 = cb_n2[lbl][:, None, :] - 2.0 * dots
+        else:
+            dots = jnp.einsum("tsl,skl->tsk", rb, cb, precision=lax.Precision.HIGHEST)
+            d2 = cb_n2[None] - 2.0 * dots
+        return jnp.argmin(d2, axis=-1).astype(jnp.uint8)
+
+    codes = lax.map(body, (rt, lt))
+    return codes.reshape(num * tile, -1)[:n]
+
+
+def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int):
+    """Scatter codes into padded lists (shared ivf::list scheme)."""
+    n, pq_dim = codes.shape
+    pos, counts = list_positions(labels, n_lists)
+    buf = jnp.zeros((n_lists, capacity, pq_dim), jnp.uint8)
+    idbuf = jnp.full((n_lists, capacity), -1, jnp.int32)
+    buf = buf.at[labels, pos].set(codes)
+    idbuf = idbuf.at[labels, pos].set(ids.astype(jnp.int32))
+    return buf, idbuf, counts.astype(jnp.int32)
+
+
+def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIndex:
+    """Build the index (reference: ivf_pq::build, ivf_pq-inl.cuh:270; call
+    stack SURVEY.md §3.B)."""
+    res = res or default_resources()
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, d)")
+    n, d = x.shape
+    expects(params.n_lists <= n, "n_lists > n_samples")
+    expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8] (ref ivf_pq_types.hpp:68)")
+    mt = resolve_metric(params.metric)
+    expects(
+        mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+               DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
+               DistanceType.InnerProduct),
+        "ivf_pq supports L2 / inner_product metrics, got %s", mt.name,
+    )
+    expects(params.codebook_kind in ("per_subspace", "per_cluster"),
+            "codebook_kind must be per_subspace|per_cluster")
+
+    pq_dim = params.pq_dim or _default_pq_dim(d)
+    pq_len = -(-d // pq_dim)
+    d_rot = pq_dim * pq_len
+    n_codes = 1 << params.pq_bits
+    key = as_key(params.seed)
+
+    # 1. coarse quantizer (ref §3.B step 2)
+    max_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
+    train_metric = "inner_product" if mt == DistanceType.InnerProduct else "sqeuclidean"
+    kb = KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
+        max_train_points=min(max_train, n),
+    )
+    centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
+
+    # 2. rotation (ref step 3)
+    key, kr = jax.random.split(key)
+    rotation = _make_rotation(kr, d_rot, d, params.force_random_rotation)
+    centers_rot = centers @ rotation.T  # (n_lists, d_rot)
+
+    # 3. residuals of the training set (ref steps 4-5)
+    tile = _choose_tile(n, params.n_lists, 1, res.workspace_bytes)
+    _, labels = _fused_l2_nn(x, centers, False, tile)
+    resid = (x.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
+    resid = resid.reshape(n, pq_dim, pq_len)
+
+    # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
+    key, kc = jax.random.split(key)
+    if params.codebook_kind == "per_subspace":
+        # (pq_dim, n, pq_len) — every subspace trains on all residuals
+        sub = jnp.moveaxis(resid, 1, 0)
+        codebooks = _train_codebooks_batched(sub, kc, n_codes, params.kmeans_n_iters)
+    else:
+        # per-cluster: pool subspace-vectors of each cluster's members.
+        # Pad each cluster's pool to a fixed size for batching.
+        pool_cap = round_up(max(int(jnp.max(jnp.bincount(labels, length=params.n_lists))), n_codes), 8)
+        order = jnp.argsort(labels, stable=True)
+        counts = jnp.bincount(labels, length=params.n_lists)
+        starts = jnp.cumsum(counts) - counts
+        # gather rows per cluster with wraparound padding (repeat members)
+        offs = jnp.arange(pool_cap)[None, :] % jnp.maximum(counts, 1)[:, None]
+        rows = jnp.take(order, starts[:, None] + offs)  # (n_lists, pool_cap)
+        pools = jnp.take(resid.reshape(n, d_rot), rows, axis=0)  # (L, pool_cap, d_rot)
+        pools = pools.reshape(params.n_lists, pool_cap * pq_dim, pq_len)
+        codebooks = _train_codebooks_batched(pools, kc, n_codes, params.kmeans_n_iters)
+
+    index = IvfPqIndex(
+        centers=centers,
+        centers_rot=centers_rot,
+        rotation=rotation,
+        codebooks=codebooks,
+        list_codes=jnp.zeros((params.n_lists, 0, pq_dim), jnp.uint8),
+        list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
+        list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+        metric=mt,
+        codebook_kind=params.codebook_kind,
+        pq_bits=params.pq_bits,
+    )
+    if not params.add_data_on_build:
+        return index
+    return extend(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
+
+
+def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None) -> IvfPqIndex:
+    """Encode + append vectors (reference: ivf_pq::extend; encode path
+    process_and_fill_codes, detail/ivf_pq_build.cuh)."""
+    res = res or default_resources()
+    x = jnp.asarray(new_vectors)
+    expects(x.ndim == 2 and x.shape[1] == index.dim, "vector dim mismatch")
+    n_new = x.shape[0]
+    if new_ids is None:
+        new_ids = index.size + jnp.arange(n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+
+    tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
+    labels = _assign_to_lists(x, index.centers, index.metric, tile)
+    resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
+    resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
+    n_codes = index.codebooks.shape[-2]
+    enc_tile = max(min(n_new, res.workspace_bytes // max(index.pq_dim * n_codes * 4, 1)), 8)
+    codes = _encode(
+        resid, index.codebooks, labels,
+        per_cluster=index.codebook_kind == "per_cluster",
+        tile=min(enc_tile, 8192),
+    )
+
+    if index.capacity > 0 and index.size > 0:
+        old_mask = index.list_ids.reshape(-1) >= 0
+        old_codes = index.list_codes.reshape(-1, index.pq_dim)[old_mask]
+        old_ids = index.list_ids.reshape(-1)[old_mask]
+        old_labels = jnp.repeat(jnp.arange(index.n_lists), index.capacity)[old_mask]
+        codes = jnp.concatenate([old_codes, codes])
+        new_ids = jnp.concatenate([old_ids, new_ids])
+        labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
+
+    sizes = jnp.bincount(labels, length=index.n_lists)
+    capacity = round_up(max(int(jnp.max(sizes)), 1), 8)
+    buf, idbuf, sizes = _fill_code_lists(codes, new_ids, labels, index.n_lists, capacity)
+    return dataclasses.replace(index, list_codes=buf, list_ids=idbuf, list_sizes=sizes)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric",
+                     "codebook_kind", "lut_bf16"),
+)
+def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: int,
+               probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_bf16: bool):
+    m, d = queries.shape
+    qf = queries.astype(jnp.float32)
+    inner = metric == DistanceType.InnerProduct
+    pq_dim, pq_len = index.pq_dim, index.pq_len
+    n_codes = index.codebooks.shape[-2]
+
+    # ---- stage 1: coarse clusters (ref select_clusters :68) ----
+    cscore = qf @ index.centers.T
+    if not inner:
+        cn = jnp.sum(index.centers * index.centers, axis=1)
+        cscore = cn[None, :] - 2.0 * cscore
+    _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+
+    # rotated queries
+    qrot = qf @ index.rotation.T  # (m, d_rot)
+
+    num = -(-m // query_tile)
+    pad = num * query_tile - m
+    qp = jnp.pad(qrot, ((0, pad), (0, 0))) if pad else qrot
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qt = qp.reshape(num, query_tile, index.rot_dim)
+    pt = pp.reshape(num, query_tile, n_probes)
+
+    n_chunks = n_probes // probe_chunk
+    cap = index.capacity
+
+    # codebook norms (for LUT via ‖c‖² - 2·r·c)
+    cb = index.codebooks.astype(jnp.float32)
+    cb_n2 = jnp.sum(cb * cb, axis=-1)  # (B?, n_codes) matching codebook layout
+
+    def per_tile(args):
+        q, pr = args  # (T, d_rot), (T, p)
+
+        def per_chunk(c, _):
+            pc = lax.dynamic_slice_in_dim(pr, c * probe_chunk, probe_chunk, axis=1)  # (T, pc)
+            crot = index.centers_rot[pc]  # (T, pc, d_rot)
+
+            # ---- LUT (ref ivfpq_search_worker :419 lut computation) ----
+            if inner:
+                # IP(q, v) = q·c + q_rot·decoded_residual: LUT over the rotated
+                # query's subvectors; the q·c bias is added to scores below.
+                qs = jnp.broadcast_to(
+                    q[:, None, :], (query_tile, probe_chunk, index.rot_dim)
+                ).reshape(query_tile, probe_chunk, pq_dim, pq_len)
+                if codebook_kind == "per_subspace":
+                    lut = jnp.einsum("tpsl,skl->tpsk", qs, cb, precision=lax.Precision.HIGHEST)
+                else:
+                    lut = jnp.einsum("tpsl,tpkl->tpsk", qs, cb[pc], precision=lax.Precision.HIGHEST)
+                bias = jnp.einsum("td,tpd->tp", q, crot, precision=lax.Precision.HIGHEST)
+            else:
+                # L2: ‖q - c - decoded‖² = Σ_s ‖r_s - codeword_s‖², r = q_rot - c_rot
+                r = (q[:, None, :] - crot).reshape(query_tile, probe_chunk, pq_dim, pq_len)
+                if codebook_kind == "per_subspace":
+                    # cb: (pq_dim, n_codes, pq_len)
+                    dots = jnp.einsum("tpsl,skl->tpsk", r, cb, precision=lax.Precision.HIGHEST)
+                    lut = cb_n2[None, None] - 2.0 * dots  # (T, pc, pq_dim, n_codes)
+                else:
+                    cbl = cb[pc]  # (T, pc, n_codes, pq_len)
+                    dots = jnp.einsum("tpsl,tpkl->tpsk", r, cbl, precision=lax.Precision.HIGHEST)
+                    lut = cb_n2[pc][:, :, None, :] - 2.0 * dots
+                # Σ_s ‖r_s‖² per probe: constant within a list, needed so
+                # scores are comparable across probed lists
+                bias = jnp.sum(r * r, axis=(2, 3))  # (T, pc)
+            if lut_bf16:
+                lut = lut.astype(jnp.bfloat16)
+
+            # ---- scan: score = Σ_s LUT[s, code_s] (ref compute_similarity) ----
+            codes = index.list_codes[pc]  # (T, pc, cap, pq_dim) gather
+            ids = index.list_ids[pc]  # (T, pc, cap)
+            lut_b = jnp.moveaxis(lut, 3, 2)  # (T, pc, n_codes, pq_dim)
+            gathered = jnp.take_along_axis(
+                lut_b, codes.astype(jnp.int32), axis=2
+            )  # (T, pc, cap, pq_dim)
+            scores = jnp.sum(gathered.astype(jnp.float32), axis=-1)  # (T, pc, cap)
+            scores = scores + bias[:, :, None]
+            scores = jnp.where(ids >= 0, scores, -jnp.inf if inner else jnp.inf)
+            flat_s = scores.reshape(query_tile, probe_chunk * cap)
+            flat_i = ids.reshape(query_tile, probe_chunk * cap)
+            return c + 1, _select_k(flat_s, flat_i, k, not inner)
+
+        _, (cv, ci) = lax.scan(per_chunk, 0, None, length=n_chunks)
+        cv = jnp.moveaxis(cv, 0, 1).reshape(query_tile, n_chunks * k)
+        ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
+        return _select_k(cv, ci, k, not inner)
+
+    dists, idx = lax.map(per_tile, (qt, pt))
+    dists = dists.reshape(num * query_tile, k)[:m]
+    idx = idx.reshape(num * query_tile, k)[:m]
+    if not inner and metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(jnp.maximum(dists, 0.0)), dists)
+    return dists, idx
+
+
+def search(params: SearchParams, index: IvfPqIndex, queries, k: int, res: Resources | None = None):
+    """Search (reference: ivf_pq::search :723; pylibraft neighbors/ivf_pq).
+
+    Returns (distances (m, k), ids (m, k)); distances are approximate
+    (PQ-quantized), id -1 marks empty candidate slots."""
+    res = res or default_resources()
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
+    expects(index.capacity > 0 and index.size > 0, "index is empty")
+    n_probes = min(params.n_probes, index.n_lists)
+    expects(k <= n_probes * index.capacity, "k exceeds probed candidate pool")
+    m = queries.shape[0]
+
+    expects(params.lut_dtype in ("float32", "bfloat16"),
+            "lut_dtype must be 'float32' or 'bfloat16', got %r", params.lut_dtype)
+    # chunk memory model: codes gather (cap*pq_dim*5 incl. scores) + LUT
+    n_codes = index.codebooks.shape[-2]
+    query_tile, probe_chunk = plan_search_tiles(
+        m, n_probes, int(k), index.capacity,
+        bytes_per_probe_row=index.capacity * index.pq_dim * 5 + index.pq_dim * n_codes * 4,
+        budget_bytes=res.workspace_bytes,
+        max_query_tile=128,
+    )
+
+    return _pq_search(
+        index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
+        index.codebook_kind, params.lut_dtype == "bfloat16",
+    )
+
+
+def save(index: IvfPqIndex, path: str) -> None:
+    """Serialize (reference: ivf_pq_serialize.cuh:52-110)."""
+    with open(path, "wb") as f:
+        serialize_scalar(f, "ivf_pq")
+        serialize_scalar(f, int(index.metric))
+        serialize_scalar(f, index.codebook_kind)
+        serialize_scalar(f, index.pq_bits)
+        for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
+                    index.list_codes, index.list_ids, index.list_sizes):
+            serialize_mdspan(f, arr)
+
+
+def load(path: str, res: Resources | None = None) -> IvfPqIndex:
+    """Deserialize (reference: ivf_pq_serialize.cuh deserialize)."""
+    with open(path, "rb") as f:
+        tag = deserialize_scalar(f)
+        expects(tag == "ivf_pq", "not an ivf_pq index file (tag=%s)", tag)
+        metric = DistanceType(deserialize_scalar(f))
+        codebook_kind = deserialize_scalar(f)
+        pq_bits = deserialize_scalar(f)
+        arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(7)]
+    return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits)

@@ -1,0 +1,106 @@
+"""CAGRA tests — recall acceptance vs brute force (reference analogue:
+cpp/test/neighbors/ann_cagra.cuh)."""
+
+import numpy as np
+import pytest
+from scipy.spatial import distance as sp_dist
+
+from raft_tpu.neighbors import cagra
+from raft_tpu.random import make_blobs
+
+
+def _recall(got_ids, true_ids):
+    hits = 0
+    for g, t in zip(got_ids, true_ids):
+        hits += len(set(g.tolist()) & set(t.tolist()))
+    return hits / true_ids.size
+
+
+@pytest.fixture(scope="module")
+def data():
+    # uniform data, like real ANN benchmark distributions: on well-separated
+    # blobs a kNN graph has no inter-cluster edges, so graph traversal cannot
+    # cross clusters lacking an entry point (the reference's CAGRA has the
+    # same property — it's inherent to graph ANN, not an implementation bug)
+    rng = np.random.default_rng(0)
+    x = rng.random((4000, 24)).astype(np.float32)
+    q = rng.random((60, 24)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def index(data):
+    x, _ = data
+    return cagra.build(
+        cagra.IndexParams(intermediate_graph_degree=48, graph_degree=24, seed=0), x
+    )
+
+
+class TestBuild:
+    def test_graph_shape_and_validity(self, index, data):
+        x, _ = data
+        g = np.asarray(index.graph)
+        assert g.shape == (4000, 24)
+        assert g.min() >= 0 and g.max() < 4000
+        # no self-edges
+        assert not (g == np.arange(4000)[:, None]).any()
+
+    def test_knn_graph_quality(self, data):
+        """Intermediate kNN graph edges should largely be true neighbors."""
+        x, _ = data
+        params = cagra.IndexParams(intermediate_graph_degree=16, graph_degree=8, seed=0)
+        g = np.asarray(cagra.build_knn_graph(params, x))
+        true_i = np.argsort(sp_dist.cdist(x[:200], x, "sqeuclidean"), 1)[:, 1:17]
+        rec = _recall(g[:200], true_i)
+        assert rec > 0.8, rec
+
+    def test_optimize_degree(self, data):
+        x, _ = data
+        params = cagra.IndexParams(intermediate_graph_degree=32, graph_degree=16, seed=0)
+        g = cagra.build_knn_graph(params, x)
+        opt = np.asarray(cagra.optimize(g, 16))
+        assert opt.shape == (4000, 16)
+        assert opt.min() >= 0
+
+
+class TestSearch:
+    def test_recall(self, index, data):
+        x, q = data
+        d, i = cagra.search(cagra.SearchParams(itopk_size=64), index, q, k=10)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        rec = _recall(np.asarray(i), true_i)
+        assert rec > 0.9, rec
+
+    def test_distances_are_exact_for_found_ids(self, index, data):
+        x, q = data
+        d, i = cagra.search(cagra.SearchParams(itopk_size=64), index, q, k=5)
+        full = sp_dist.cdist(q, x, "sqeuclidean")
+        got = np.take_along_axis(full, np.asarray(i), 1)
+        np.testing.assert_allclose(np.asarray(d), got, atol=1e-2, rtol=1e-3)
+
+    def test_wider_beam_improves_recall(self, index, data):
+        x, q = data
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        recalls = []
+        for itopk in (16, 64, 128):
+            _, i = cagra.search(cagra.SearchParams(itopk_size=itopk), index, q, k=10)
+            recalls.append(_recall(np.asarray(i), true_i))
+        assert recalls[-1] >= recalls[0]
+        assert recalls[-1] > 0.95, recalls
+
+    def test_search_width(self, index, data):
+        x, q = data
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        _, i = cagra.search(cagra.SearchParams(itopk_size=64, search_width=4), index, q, k=10)
+        assert _recall(np.asarray(i), true_i) > 0.9
+
+
+class TestSerialize:
+    def test_roundtrip(self, tmp_path, index, data):
+        _, q = data
+        p = str(tmp_path / "cagra.bin")
+        cagra.save(index, p)
+        idx2 = cagra.load(p)
+        d1, i1 = cagra.search(cagra.SearchParams(itopk_size=32), index, q, k=5)
+        d2, i2 = cagra.search(cagra.SearchParams(itopk_size=32), idx2, q, k=5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
