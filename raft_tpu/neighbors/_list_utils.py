@@ -9,11 +9,24 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["round_up", "list_positions", "plan_search_tiles"]
+from ..distance.fused_nn import _fused_l2_nn
+from ..distance.types import DistanceType
+
+__all__ = ["round_up", "list_positions", "plan_search_tiles", "assign_to_lists"]
 
 
 def round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
+
+
+def assign_to_lists(x, centers, metric: DistanceType, tile: int):
+    """List assignment consistent with the index metric (the reference uses
+    kmeans_balanced::predict with the index metric so storage placement and
+    search probing agree)."""
+    if metric == DistanceType.InnerProduct:
+        scores = jnp.asarray(x).astype(jnp.float32) @ jnp.asarray(centers).T
+        return jnp.argmax(scores, axis=1).astype(jnp.int32)
+    return _fused_l2_nn(x, centers, False, tile)[1]
 
 
 def list_positions(labels, n_lists: int):

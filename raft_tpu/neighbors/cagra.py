@@ -226,7 +226,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
         "cagra supports L2 metrics (reference parity), got %s", mt.name,
     )
     knn_graph = build_knn_graph(params, x, res=res)
-    graph = optimize(knn_graph, params.graph_degree)
+    graph = optimize(knn_graph, params.graph_degree, res=res)
     return CagraIndex(dataset=x, graph=graph, metric=mt)
 
 
@@ -337,8 +337,8 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
-    itopk = max(params.itopk_size, k)
-    expects(k <= itopk, "k must be <= itopk_size")
+    expects(k <= params.itopk_size, "k must be <= itopk_size (ref cagra_types.hpp:66)")
+    itopk = params.itopk_size
     max_iter = params.max_iterations or (itopk // max(params.search_width, 1) + 10)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
     return _cagra_search(index, queries, int(k), int(itopk), int(max_iter),

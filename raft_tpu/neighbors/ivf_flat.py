@@ -34,23 +34,12 @@ from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
-from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
-from ._list_utils import list_positions, plan_search_tiles, round_up
+from ._list_utils import assign_to_lists, list_positions, plan_search_tiles, round_up
 
 __all__ = ["IndexParams", "SearchParams", "IvfFlatIndex", "build", "extend", "search", "save", "load"]
-
-
-def _assign_to_lists(x, centers, metric: DistanceType, tile: int):
-    """List assignment consistent with the index metric (the reference uses
-    kmeans_balanced::predict with the index metric so storage placement and
-    search probing agree)."""
-    if metric == DistanceType.InnerProduct:
-        scores = jnp.asarray(x).astype(jnp.float32) @ jnp.asarray(centers).T
-        return jnp.argmax(scores, axis=1).astype(jnp.int32)
-    return _fused_l2_nn(x, centers, False, tile)[1]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,7 +189,7 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
-    labels = _assign_to_lists(x, index.centers, index.metric, tile)
+    labels = assign_to_lists(x, index.centers, index.metric, tile)
 
     # merge with existing list contents (flatten old lists back to rows)
     if index.capacity > 0 and index.size > 0:

@@ -37,13 +37,11 @@ from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
-from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
 from ..random.rng import as_key
-from ._list_utils import list_positions, plan_search_tiles, round_up
-from .ivf_flat import _assign_to_lists
+from ._list_utils import assign_to_lists, list_positions, plan_search_tiles, round_up
 
 __all__ = ["IndexParams", "SearchParams", "IvfPqIndex", "build", "extend", "search", "save", "load"]
 
@@ -263,16 +261,25 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     rotation = _make_rotation(kr, d_rot, d, params.force_random_rotation)
     centers_rot = centers @ rotation.T  # (n_lists, d_rot)
 
-    # 3. residuals of the training set (ref steps 4-5)
-    tile = _choose_tile(n, params.n_lists, 1, res.workspace_bytes)
-    _, labels = _fused_l2_nn(x, centers, False, tile)
-    resid = (x.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
-    resid = resid.reshape(n, pq_dim, pq_len)
+    # 3. residuals of a training subsample (ref steps 4-5 — the reference
+    # trains codebooks on the same subsampled trainset as the coarse
+    # quantizer, train_per_subset operates on the trainset, not the dataset)
+    n_train = min(max_train, n)
+    key, ks = jax.random.split(key)
+    if n_train < n:
+        train_idx = jax.random.choice(ks, n, (n_train,), replace=False)
+        xt = jnp.take(x, train_idx, axis=0)
+    else:
+        xt = x
+    tile = _choose_tile(n_train, params.n_lists, 1, res.workspace_bytes)
+    labels = assign_to_lists(xt, centers, mt, tile)
+    resid = (xt.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
+    resid = resid.reshape(n_train, pq_dim, pq_len)
 
     # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
     key, kc = jax.random.split(key)
     if params.codebook_kind == "per_subspace":
-        # (pq_dim, n, pq_len) — every subspace trains on all residuals
+        # (pq_dim, n_train, pq_len) — every subspace trains on all residuals
         sub = jnp.moveaxis(resid, 1, 0)
         codebooks = _train_codebooks_batched(sub, kc, n_codes, params.kmeans_n_iters)
     else:
@@ -285,7 +292,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         # gather rows per cluster with wraparound padding (repeat members)
         offs = jnp.arange(pool_cap)[None, :] % jnp.maximum(counts, 1)[:, None]
         rows = jnp.take(order, starts[:, None] + offs)  # (n_lists, pool_cap)
-        pools = jnp.take(resid.reshape(n, d_rot), rows, axis=0)  # (L, pool_cap, d_rot)
+        pools = jnp.take(resid.reshape(n_train, d_rot), rows, axis=0)  # (L, pool_cap, d_rot)
         pools = pools.reshape(params.n_lists, pool_cap * pq_dim, pq_len)
         codebooks = _train_codebooks_batched(pools, kc, n_codes, params.kmeans_n_iters)
 
@@ -319,7 +326,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
-    labels = _assign_to_lists(x, index.centers, index.metric, tile)
+    labels = assign_to_lists(x, index.centers, index.metric, tile)
     resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
     resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
     n_codes = index.codebooks.shape[-2]

@@ -1,1 +1,62 @@
-"""raft_tpu.sparse — raft/sparse (S1-S7). Under construction."""
+"""raft_tpu.sparse — sparse containers, conversions, linalg, ops, distances,
+neighbors (reference: raft/sparse — S1-S7 in SURVEY.md §2.4)."""
+
+from .types import CooMatrix, CsrMatrix, make_coo, make_csr, from_scipy
+from .convert import (
+    coo_to_csr,
+    csr_to_coo,
+    dense_to_csr,
+    dense_to_coo,
+    csr_to_dense,
+    coo_to_dense,
+    adj_to_csr,
+    sort_coo,
+)
+from .linalg import (
+    spmv,
+    spmm,
+    add,
+    degree,
+    row_norm,
+    normalize_rows,
+    transpose,
+    symmetrize,
+    laplacian,
+)
+from .op import (
+    sum_duplicates,
+    max_duplicates,
+    filter_entries,
+    remove_zeros,
+    slice_rows,
+)
+
+__all__ = [
+    "CooMatrix",
+    "CsrMatrix",
+    "make_coo",
+    "make_csr",
+    "from_scipy",
+    "coo_to_csr",
+    "csr_to_coo",
+    "dense_to_csr",
+    "dense_to_coo",
+    "csr_to_dense",
+    "coo_to_dense",
+    "adj_to_csr",
+    "sort_coo",
+    "spmv",
+    "spmm",
+    "add",
+    "degree",
+    "row_norm",
+    "normalize_rows",
+    "transpose",
+    "symmetrize",
+    "laplacian",
+    "sum_duplicates",
+    "max_duplicates",
+    "filter_entries",
+    "remove_zeros",
+    "slice_rows",
+]

@@ -1,0 +1,101 @@
+"""Sparse format conversions (reference: raft/sparse/convert/{coo,csr,dense}.cuh,
+detail/adj_to_csr.cuh).
+
+All conversions keep static capacities; sorting uses two stable argsorts
+(col-major then row-major key) instead of 64-bit fused keys so everything
+stays in int32 on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .types import CooMatrix, CsrMatrix
+
+__all__ = [
+    "coo_to_csr",
+    "csr_to_coo",
+    "dense_to_csr",
+    "dense_to_coo",
+    "csr_to_dense",
+    "coo_to_dense",
+    "adj_to_csr",
+    "sort_coo",
+]
+
+
+def sort_coo(coo: CooMatrix) -> CooMatrix:
+    """Sort COO entries by (row, col); padding (row==shape[0]) sorts last.
+
+    Reference: raft/sparse/op/sort.cuh (coo_sort — thrust sort_by_key on a
+    fused 64-bit key). TPU version: two stable argsorts.
+    """
+    order = jnp.argsort(coo.cols, stable=True)
+    rows, cols, vals = coo.rows[order], coo.cols[order], coo.vals[order]
+    order = jnp.argsort(rows, stable=True)
+    return CooMatrix(rows[order], cols[order], vals[order], coo.nnz, coo.shape)
+
+
+def coo_to_csr(coo: CooMatrix, assume_sorted: bool = False) -> CsrMatrix:
+    """COO → CSR (reference: raft/sparse/convert/csr.cuh sorted_coo_to_csr)."""
+    if not assume_sorted:
+        coo = sort_coo(coo)
+    n_rows = coo.shape[0]
+    # indptr[r] = number of valid entries with row < r
+    counts = jnp.zeros((n_rows + 1,), jnp.int32).at[coo.rows].add(
+        coo.valid_mask().astype(jnp.int32), mode="drop"
+    )
+    indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts[:-1])]).astype(
+        jnp.int32
+    )
+    indptr = indptr.at[-1].set(coo.nnz)
+    indices = jnp.where(coo.valid_mask(), coo.cols, coo.shape[1])
+    data = jnp.where(coo.valid_mask(), coo.vals, 0)
+    return CsrMatrix(indptr, indices, data, coo.shape)
+
+
+def csr_to_coo(csr: CsrMatrix) -> CooMatrix:
+    """CSR → COO (reference: raft/sparse/convert/coo.cuh csr_to_coo)."""
+    return CooMatrix(csr.row_ids(), csr.indices, csr.data, csr.nnz, csr.shape)
+
+
+def dense_to_coo(x: jax.Array, cap: int | None = None) -> CooMatrix:
+    """Dense → COO keeping explicit zeros out; cap defaults to x.size.
+
+    Reference: raft/sparse/convert/dense path (cusparse dense2csr).
+    """
+    n, m = x.shape
+    cap = n * m if cap is None else cap
+    mask = (x != 0).ravel()
+    nnz = jnp.sum(mask).astype(jnp.int32)
+    flat = jnp.arange(n * m, dtype=jnp.int32)
+    # stable partition: valid entries first, in row-major order
+    order = jnp.argsort(~mask, stable=True)[:cap]
+    sel = flat[order]
+    valid = mask[order]
+    rows = jnp.where(valid, sel // m, n).astype(jnp.int32)
+    cols = jnp.where(valid, sel % m, m).astype(jnp.int32)
+    vals = jnp.where(valid, x.ravel()[order], 0)
+    return CooMatrix(rows, cols, vals, nnz, (n, m))
+
+
+def dense_to_csr(x: jax.Array, cap: int | None = None) -> CsrMatrix:
+    """Dense → CSR (reference: raft/sparse/convert/csr.cuh)."""
+    return coo_to_csr(dense_to_coo(x, cap), assume_sorted=True)
+
+
+def csr_to_dense(csr: CsrMatrix) -> jax.Array:
+    return csr.todense()
+
+
+def coo_to_dense(coo: CooMatrix) -> jax.Array:
+    return coo.todense()
+
+
+def adj_to_csr(adj: jax.Array) -> CsrMatrix:
+    """Boolean adjacency matrix → CSR with unit weights.
+
+    Reference: raft/sparse/convert/detail/adj_to_csr.cuh (adj_to_csr kernel).
+    """
+    return dense_to_csr(adj.astype(jnp.float32))

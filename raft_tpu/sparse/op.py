@@ -1,0 +1,93 @@
+"""Structural sparse ops (reference: raft/sparse/op/{filter,reduce,row_op,
+slice,sort}.cuh).
+
+Duplicate reduction works on *sorted* COO: run-starts are detected by
+comparing adjacent (row, col) pairs, then values are combined into the
+run-start slot with a scatter — the TPU replacement for the reference's
+hash/sort reduce (sparse/op/reduce.cuh max_duplicates).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .types import CooMatrix
+
+__all__ = [
+    "sum_duplicates",
+    "max_duplicates",
+    "filter_entries",
+    "remove_zeros",
+    "slice_rows",
+]
+
+
+def _runs(coo: CooMatrix):
+    """For sorted COO: (segment id of each entry, is-run-start mask)."""
+    valid = coo.valid_mask()
+    prev_r = jnp.roll(coo.rows, 1)
+    prev_c = jnp.roll(coo.cols, 1)
+    is_start = (coo.rows != prev_r) | (coo.cols != prev_c)
+    is_start = is_start.at[0].set(True) & valid
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1  # segment id per entry
+    return seg, is_start, valid
+
+
+def _dedupe(coo: CooMatrix, combine: str) -> CooMatrix:
+    seg, is_start, valid = _runs(coo)
+    n_seg = jnp.sum(is_start.astype(jnp.int32))
+    cap = coo.cap
+    drop = jnp.where(valid, seg, cap)  # invalid entries scatter out of range
+    if combine == "sum":
+        vals = jnp.zeros((cap,), coo.vals.dtype).at[drop].add(coo.vals, mode="drop")
+    else:
+        vals = jnp.full((cap,), -jnp.inf, coo.vals.dtype).at[drop].max(coo.vals, mode="drop")
+        vals = jnp.where(jnp.arange(cap) < n_seg, vals, 0)
+    # compact run-start coordinates into segment slots
+    rows = jnp.full((cap,), coo.shape[0], jnp.int32).at[
+        jnp.where(is_start, seg, cap)
+    ].set(coo.rows, mode="drop")
+    cols = jnp.full((cap,), coo.shape[1], jnp.int32).at[
+        jnp.where(is_start, seg, cap)
+    ].set(coo.cols, mode="drop")
+    return CooMatrix(rows, cols, vals, n_seg.astype(jnp.int32), coo.shape)
+
+
+def sum_duplicates(coo: CooMatrix) -> CooMatrix:
+    """Combine duplicate (row, col) entries by sum. Input must be sorted."""
+    return _dedupe(coo, "sum")
+
+
+def max_duplicates(coo: CooMatrix) -> CooMatrix:
+    """Combine duplicate (row, col) entries by max (reference:
+    sparse/op/reduce.cuh max_duplicates). Input must be sorted."""
+    return _dedupe(coo, "max")
+
+
+def filter_entries(coo: CooMatrix, keep_mask: jax.Array) -> CooMatrix:
+    """Keep entries where keep_mask is True, compacting to the front
+    (reference: sparse/op/filter.cuh coo_remove_scalar)."""
+    keep = keep_mask & coo.valid_mask()
+    order = jnp.argsort(~keep, stable=True)
+    nnz = jnp.sum(keep.astype(jnp.int32))
+    kept = keep[order]
+    rows = jnp.where(kept, coo.rows[order], coo.shape[0])
+    cols = jnp.where(kept, coo.cols[order], coo.shape[1])
+    vals = jnp.where(kept, coo.vals[order], 0)
+    return CooMatrix(rows, cols, vals, nnz, coo.shape)
+
+
+def remove_zeros(coo: CooMatrix) -> CooMatrix:
+    """Drop explicit zeros (reference: sparse/op/filter.cuh coo_remove_zeros)."""
+    return filter_entries(coo, coo.vals != 0)
+
+
+def slice_rows(coo: CooMatrix, start: int, stop: int) -> CooMatrix:
+    """Select rows in [start, stop), re-indexed to 0 (reference:
+    sparse/op/slice.cuh csr_row_slice_indptr)."""
+    keep = (coo.rows >= start) & (coo.rows < stop)
+    sliced = filter_entries(coo, keep)
+    new_shape = (stop - start, coo.shape[1])
+    rows = jnp.where(sliced.valid_mask(), sliced.rows - start, new_shape[0])
+    return CooMatrix(rows, sliced.cols, sliced.vals, sliced.nnz, new_shape)

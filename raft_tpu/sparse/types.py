@@ -1,0 +1,158 @@
+"""Sparse containers: padded COO / CSR matrices.
+
+TPU-first design: unlike the reference's exact-nnz device buffers
+(cpp/include/raft/core/sparse_types.hpp, core/device_coo_matrix.hpp,
+core/device_csr_matrix.hpp, sparse/coo.hpp, sparse/csr.hpp), these
+containers carry a *static* capacity ``cap`` with a dynamic valid count
+``nnz`` — XLA requires static shapes, so every structural op masks by
+position rather than reallocating. Padding convention:
+
+  * COO: padding entries have ``rows == shape[0]`` (one past the last valid
+    row) so scatter ops drop them with ``mode='drop'``; vals are 0.
+  * CSR: ``indptr[-1] == nnz``; entries at positions >= nnz are padding with
+    ``indices == shape[1]`` and ``data == 0``.
+
+Both are registered pytrees (shape/cap are static aux data) so they pass
+through jit/vmap/shard_map transparently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["CooMatrix", "CsrMatrix", "make_coo", "make_csr"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CooMatrix:
+    """Padded COO matrix (reference: raft/core/device_coo_matrix.hpp, sparse/coo.hpp).
+
+    rows/cols: int32[cap], vals: float[cap]; entries past ``nnz`` are padding
+    with ``rows == shape[0]``.
+    """
+
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    nnz: jax.Array  # int32 scalar (dynamic)
+    shape: Tuple[int, int]  # static
+
+    @property
+    def cap(self) -> int:
+        return self.rows.shape[0]
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def valid_mask(self) -> jax.Array:
+        return jnp.arange(self.cap, dtype=jnp.int32) < self.nnz
+
+    def todense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.vals.dtype)
+        return out.at[self.rows, self.cols].add(self.vals, mode="drop")
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals, self.nnz), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        rows, cols, vals, nnz = children
+        return cls(rows, cols, vals, nnz, aux[0])
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CsrMatrix:
+    """Padded CSR matrix (reference: raft/core/device_csr_matrix.hpp, sparse/csr.hpp).
+
+    indptr: int32[n_rows+1] (indptr[-1] == nnz), indices: int32[cap],
+    data: float[cap]; entries past ``nnz`` are padding with
+    ``indices == shape[1]``.
+    """
+
+    indptr: jax.Array
+    indices: jax.Array
+    data: jax.Array
+    shape: Tuple[int, int]  # static
+
+    @property
+    def cap(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def nnz(self) -> jax.Array:
+        return self.indptr[-1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def valid_mask(self) -> jax.Array:
+        return jnp.arange(self.cap, dtype=jnp.int32) < self.nnz
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to a per-entry row id (padding entries get shape[0])."""
+        # row of entry e = (# of row starts <= e) - 1, computed via searchsorted
+        pos = jnp.arange(self.cap, dtype=jnp.int32)
+        rows = jnp.searchsorted(self.indptr[1:], pos, side="right").astype(jnp.int32)
+        return jnp.where(self.valid_mask(), rows, self.shape[0])
+
+    def todense(self) -> jax.Array:
+        out = jnp.zeros(self.shape, self.data.dtype)
+        return out.at[self.row_ids(), self.indices].add(self.data, mode="drop")
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        indptr, indices, data = children
+        return cls(indptr, indices, data, aux[0])
+
+
+def make_coo(rows, cols, vals, shape, cap: int | None = None) -> CooMatrix:
+    """Build a padded CooMatrix from exact-length host/device triplets."""
+    rows = jnp.asarray(rows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    vals = jnp.asarray(vals)
+    nnz = int(rows.shape[0])
+    cap = nnz if cap is None else int(cap)
+    if cap < nnz:
+        raise ValueError(f"cap {cap} < nnz {nnz}")
+    pad = cap - nnz
+    rows = jnp.concatenate([rows, jnp.full((pad,), shape[0], jnp.int32)])
+    cols = jnp.concatenate([cols, jnp.full((pad,), shape[1], jnp.int32)])
+    vals = jnp.concatenate([vals, jnp.zeros((pad,), vals.dtype)])
+    return CooMatrix(rows, cols, vals, jnp.int32(nnz), (int(shape[0]), int(shape[1])))
+
+
+def make_csr(indptr, indices, data, shape, cap: int | None = None) -> CsrMatrix:
+    """Build a padded CsrMatrix from exact-length host/device CSR arrays."""
+    indptr = jnp.asarray(indptr, jnp.int32)
+    indices = jnp.asarray(indices, jnp.int32)
+    data = jnp.asarray(data)
+    nnz = int(indices.shape[0])
+    cap = nnz if cap is None else int(cap)
+    if cap < nnz:
+        raise ValueError(f"cap {cap} < nnz {nnz}")
+    pad = cap - nnz
+    indices = jnp.concatenate([indices, jnp.full((pad,), shape[1], jnp.int32)])
+    data = jnp.concatenate([data, jnp.zeros((pad,), data.dtype)])
+    return CsrMatrix(indptr, indices, data, (int(shape[0]), int(shape[1])))
+
+
+def from_scipy(sp, cap: int | None = None):
+    """Convenience ingestion from a scipy.sparse matrix (tests/tooling)."""
+    if sp.format == "coo":
+        return make_coo(sp.row, sp.col, sp.data, sp.shape, cap)
+    csr = sp.tocsr()
+    return make_csr(
+        np.asarray(csr.indptr), np.asarray(csr.indices), np.asarray(csr.data), csr.shape, cap
+    )

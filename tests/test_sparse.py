@@ -1,0 +1,183 @@
+"""Sparse layer tests vs scipy.sparse references.
+
+Mirrors the reference's SPARSE_TEST gtest suite strategy (SURVEY.md §4):
+results compared against a trusted host implementation (scipy here, naive
+loops there).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import jax.numpy as jnp
+
+from raft_tpu import sparse
+
+
+def _random_csr(rng, n, m, density=0.2, cap_extra=7):
+    sp = sps.random(n, m, density=density, random_state=np.random.RandomState(rng.integers(1 << 30)), format="csr", dtype=np.float32)
+    sp.data = sp.data.astype(np.float32) + 0.1  # avoid exact zeros
+    return sp, sparse.from_scipy(sp, cap=sp.nnz + cap_extra)
+
+
+class TestTypes:
+    def test_coo_dense_roundtrip(self, rng):
+        sp = sps.random(13, 9, density=0.3, format="coo", dtype=np.float32)
+        coo = sparse.from_scipy(sp, cap=sp.nnz + 5)
+        np.testing.assert_allclose(np.asarray(coo.todense()), sp.toarray(), rtol=1e-6)
+
+    def test_csr_dense_roundtrip(self, rng):
+        sp, csr = _random_csr(rng, 11, 17)
+        np.testing.assert_allclose(np.asarray(csr.todense()), sp.toarray(), rtol=1e-6)
+
+    def test_csr_row_ids(self, rng):
+        sp, csr = _random_csr(rng, 8, 8)
+        ids = np.asarray(csr.row_ids())
+        expect = sp.tocoo().row
+        np.testing.assert_array_equal(ids[: sp.nnz], expect)
+        assert (ids[sp.nnz :] == 8).all()
+
+
+class TestConvert:
+    def test_coo_csr_roundtrip(self, rng):
+        sp = sps.random(10, 12, density=0.25, format="coo", dtype=np.float32)
+        coo = sparse.from_scipy(sp.tocoo(), cap=sp.nnz + 3)
+        csr = sparse.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr.todense()), sp.toarray(), rtol=1e-6)
+        back = sparse.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(back.todense()), sp.toarray(), rtol=1e-6)
+
+    def test_dense_to_csr(self, rng):
+        x = rng.random((9, 7), dtype=np.float32)
+        x[x < 0.5] = 0
+        csr = sparse.dense_to_csr(jnp.asarray(x))
+        assert int(csr.nnz) == (x != 0).sum()
+        np.testing.assert_allclose(np.asarray(csr.todense()), x, rtol=1e-6)
+
+    def test_adj_to_csr(self, rng):
+        adj = rng.random((6, 6)) < 0.4
+        csr = sparse.adj_to_csr(jnp.asarray(adj))
+        np.testing.assert_array_equal(np.asarray(csr.todense()) != 0, adj)
+
+
+class TestLinalg:
+    def test_spmm(self, rng):
+        sp, csr = _random_csr(rng, 12, 15)
+        b = rng.random((15, 6), dtype=np.float32)
+        out = sparse.spmm(csr, jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(out), sp @ b, rtol=1e-5, atol=1e-5)
+
+    def test_spmv(self, rng):
+        sp, csr = _random_csr(rng, 12, 15)
+        v = rng.random(15, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(sparse.spmv(csr, jnp.asarray(v))), sp @ v, rtol=1e-5, atol=1e-5)
+
+    def test_add(self, rng):
+        sa, ca = _random_csr(rng, 9, 9)
+        sb, cb = _random_csr(rng, 9, 9)
+        out = sparse.add(ca, cb)
+        np.testing.assert_allclose(np.asarray(out.todense()), (sa + sb).toarray(), rtol=1e-5, atol=1e-6)
+
+    def test_degree(self, rng):
+        sp, csr = _random_csr(rng, 10, 10)
+        np.testing.assert_array_equal(np.asarray(sparse.degree(csr)), np.diff(sp.indptr))
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "linf"])
+    def test_row_norm(self, rng, norm):
+        sp, csr = _random_csr(rng, 10, 10)
+        dense = sp.toarray()
+        expect = {
+            "l1": np.abs(dense).sum(1),
+            "l2": (dense**2).sum(1),
+            "linf": np.abs(dense).max(1),
+        }[norm]
+        np.testing.assert_allclose(np.asarray(sparse.row_norm(csr, norm)), expect, rtol=1e-5, atol=1e-6)
+
+    def test_normalize_rows_l1(self, rng):
+        sp, csr = _random_csr(rng, 10, 10, density=0.4)
+        out = np.asarray(sparse.normalize_rows(csr, "l1").todense())
+        sums = np.abs(out).sum(1)
+        nz = np.abs(sp.toarray()).sum(1) > 0
+        np.testing.assert_allclose(sums[nz], 1.0, rtol=1e-5)
+
+    def test_transpose(self, rng):
+        sp, csr = _random_csr(rng, 7, 12)
+        out = sparse.transpose(csr)
+        assert out.shape == (12, 7)
+        np.testing.assert_allclose(np.asarray(out.todense()), sp.T.toarray(), rtol=1e-6)
+
+    @pytest.mark.parametrize("mode", ["sum", "max"])
+    def test_symmetrize(self, rng, mode):
+        sp, csr = _random_csr(rng, 8, 8)
+        out = np.asarray(sparse.symmetrize(csr, mode).todense())
+        d = sp.toarray()
+        expect = d + d.T if mode == "sum" else np.maximum(d, d.T)
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    def test_laplacian(self, rng):
+        sp, csr = _random_csr(rng, 8, 8)
+        # symmetrize first: laplacians are for undirected graphs
+        sym = sparse.symmetrize(csr, "sum")
+        lap = np.asarray(sparse.laplacian(sym).todense())
+        a = np.asarray(sym.todense())
+        expect = np.diag(a.sum(1)) - a
+        np.testing.assert_allclose(lap, expect, rtol=1e-5, atol=1e-5)
+
+    def test_laplacian_normalized(self, rng):
+        sp, csr = _random_csr(rng, 8, 8)
+        sym = sparse.symmetrize(csr, "sum")
+        lap = np.asarray(sparse.laplacian(sym, normalized=True).todense())
+        a = np.asarray(sym.todense())
+        d = a.sum(1)
+        dinv = np.where(d > 0, 1 / np.sqrt(d), 0)
+        expect = np.eye(8) - dinv[:, None] * a * dinv[None, :]
+        np.testing.assert_allclose(lap, expect, rtol=1e-5, atol=1e-5)
+
+
+class TestOps:
+    def test_sum_duplicates(self, rng):
+        rows = np.array([0, 0, 1, 1, 1, 2], np.int32)
+        cols = np.array([1, 1, 0, 0, 2, 2], np.int32)
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], np.float32)
+        coo = sparse.make_coo(rows, cols, vals, (3, 3), cap=10)
+        out = sparse.sum_duplicates(sparse.sort_coo(coo))
+        assert int(out.nnz) == 4
+        expect = np.zeros((3, 3), np.float32)
+        np.add.at(expect, (rows, cols), vals)
+        np.testing.assert_allclose(np.asarray(out.todense()), expect, rtol=1e-6)
+
+    def test_max_duplicates(self, rng):
+        rows = np.array([0, 0, 2], np.int32)
+        cols = np.array([1, 1, 0], np.int32)
+        vals = np.array([5.0, 2.0, 7.0], np.float32)
+        coo = sparse.make_coo(rows, cols, vals, (3, 3), cap=6)
+        out = sparse.max_duplicates(sparse.sort_coo(coo))
+        assert int(out.nnz) == 2
+        dense = np.asarray(out.todense())
+        assert dense[0, 1] == 5.0 and dense[2, 0] == 7.0
+
+    def test_remove_zeros(self, rng):
+        rows = np.array([0, 1, 2], np.int32)
+        cols = np.array([0, 1, 2], np.int32)
+        vals = np.array([1.0, 0.0, 3.0], np.float32)
+        coo = sparse.make_coo(rows, cols, vals, (3, 3), cap=5)
+        out = sparse.remove_zeros(coo)
+        assert int(out.nnz) == 2
+
+    def test_slice_rows(self, rng):
+        sp, csr = _random_csr(rng, 10, 6)
+        coo = sparse.csr_to_coo(csr)
+        out = sparse.slice_rows(coo, 3, 8)
+        np.testing.assert_allclose(np.asarray(out.todense()), sp.toarray()[3:8], rtol=1e-6)
+
+    def test_ops_jittable(self, rng):
+        import jax
+
+        sp, csr = _random_csr(rng, 8, 8)
+
+        @jax.jit
+        def f(c, b):
+            return sparse.spmm(c, b)
+
+        b = jnp.asarray(rng.random((8, 4), dtype=np.float32))
+        np.testing.assert_allclose(np.asarray(f(csr, b)), sp @ np.asarray(b), rtol=1e-5, atol=1e-5)
