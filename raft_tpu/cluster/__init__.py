@@ -1,16 +1,20 @@
-"""raft_tpu.cluster — k-means family and (later) single-linkage.
+"""raft_tpu.cluster — k-means family and single-linkage HAC.
 
 Reference: cpp/include/raft/cluster/ (L4, K1-K3).
 """
 
 from . import kmeans, kmeans_balanced
+from . import single_linkage as _single_linkage_mod
 from .kmeans import KMeansOutput, KMeansParams
 from .kmeans_balanced import KMeansBalancedParams
+from .single_linkage import SingleLinkageOutput, single_linkage
 
 __all__ = [
     "kmeans",
     "kmeans_balanced",
+    "single_linkage",
     "KMeansParams",
     "KMeansOutput",
     "KMeansBalancedParams",
+    "SingleLinkageOutput",
 ]

@@ -1,0 +1,212 @@
+"""Single-linkage hierarchical agglomerative clustering.
+
+Reference: raft/cluster/single_linkage.cuh (single_linkage :85) — pipeline
+(SURVEY.md K3): connectivities (full pairwise or kNN graph,
+cluster/detail/connectivities.cuh) → MST + connect_components fix-up
+(cluster/detail/mst.cuh) → agglomerative dendrogram + cut_tree labeling
+(cluster/detail/agglomerative.cuh).
+
+TPU split: the O(n²)/O(nk) graph construction and Borůvka MST run on device
+(MXU distances, while-loop MST); the dendrogram build is a strictly
+sequential n-1-step union-find — inherently serial, so it runs as a small
+host numpy loop over the already-sorted device MST edges (the reference
+dedicates a serial device kernel to the same step, which a TPU has no
+latitude for).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance.types import DistanceType, resolve_metric
+from ..neighbors.brute_force import knn as dense_knn
+from ..solver.mst import mst
+from ..sparse.convert import sort_coo
+from ..sparse.neighbors import connect_components
+from ..sparse.op import max_duplicates
+from ..sparse.types import CooMatrix
+
+__all__ = ["SingleLinkageOutput", "single_linkage", "build_dendrogram_host", "cut_tree_host"]
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """Reference: linkage_output (cluster/single_linkage_types.hpp)."""
+
+    labels: jax.Array  # (n,) int32
+    children: np.ndarray  # (n-1, 2) merge tree (scipy linkage convention)
+    deltas: np.ndarray  # (n-1,) merge distances
+    sizes: np.ndarray  # (n-1,) merged cluster sizes
+    n_clusters: int
+
+
+def build_dendrogram_host(src, dst, weights, n: int):
+    """Sequential union-find dendrogram from sorted MST edges.
+
+    Reference: cluster/detail/agglomerative.cuh build_dendrogram_host — the
+    same algorithm (it, too, runs the serial merge on host via managed
+    memory). Returns (children (n-1, 2), deltas, sizes) in scipy convention
+    (new cluster ids n, n+1, ...).
+    """
+    parent = np.arange(2 * n - 1, dtype=np.int64)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    children = np.zeros((n - 1, 2), np.int64)
+    deltas = np.zeros((n - 1,), np.float64)
+    sizes = np.zeros((n - 1,), np.int64)
+    csize = np.ones(2 * n - 1, np.int64)
+    nxt = n
+    m = 0
+    for e in range(len(src)):
+        a, b = int(src[e]), int(dst[e])
+        if a >= n or b >= n:
+            continue
+        ra, rb = find(a), find(b)
+        if ra == rb:
+            continue
+        children[m] = (min(ra, rb), max(ra, rb))
+        deltas[m] = float(weights[e])
+        sizes[m] = csize[ra] + csize[rb]
+        parent[ra] = parent[rb] = nxt
+        csize[nxt] = sizes[m]
+        nxt += 1
+        m += 1
+        if m == n - 1:
+            break
+    return children[:m], deltas[:m], sizes[:m]
+
+
+def cut_tree_host(children, n: int, n_clusters: int):
+    """Flatten the dendrogram at n_clusters (reference:
+    cluster/detail/agglomerative.cuh extract_flattened_clusters)."""
+    n_merges = max(n - n_clusters, 0)
+    parent = np.arange(2 * n - 1, dtype=np.int64)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for m in range(min(n_merges, len(children))):
+        a, b = children[m]
+        nxt = n + m
+        parent[find(a)] = nxt
+        parent[find(b)] = nxt
+    roots = {}
+    labels = np.zeros(n, np.int32)
+    for i in range(n):
+        r = find(i)
+        if r not in roots:
+            roots[r] = len(roots)
+        labels[i] = roots[r]
+    return labels
+
+
+def single_linkage(
+    x,
+    n_clusters: int,
+    connectivity: str = "knn",
+    n_neighbors: int = 15,
+    metric: str = "sqeuclidean",
+    res: Resources | None = None,
+) -> SingleLinkageOutput:
+    """Single-linkage clustering of dense points.
+
+    Reference: raft::cluster::single_linkage (cluster/single_linkage.cuh:85;
+    LinkageDistance {PAIRWISE, KNN_GRAPH} cluster/single_linkage_types.hpp).
+    ``connectivity``: "pairwise" builds the complete graph; "knn" builds an
+    n_neighbors graph and repairs disconnected components with
+    connect_components (the reference's KNN_GRAPH path).
+    """
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    expects(x.ndim == 2, "x must be (n, d)")
+    n = x.shape[0]
+    expects(1 <= n_clusters <= n, "n_clusters must be in [1, n]")
+    mt = resolve_metric(metric)
+
+    if connectivity == "pairwise":
+        from ..distance.pairwise import pairwise_distance
+
+        d = pairwise_distance(x, x, metric=mt, res=res)
+        iu, ju = jnp.triu_indices(n, k=1)
+        graph = CooMatrix(
+            iu.astype(jnp.int32), ju.astype(jnp.int32), d[iu, ju],
+            jnp.int32(iu.shape[0]), (n, n),
+        )
+    else:
+        expects(connectivity == "knn", "connectivity must be 'pairwise' or 'knn'")
+        expects(
+            mt in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                   DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded),
+            "knn connectivity requires an L2 metric (reference parity: "
+            "cluster/detail/connectivities.cuh knn path is L2-only), got %s", mt.name,
+        )
+        k = min(n_neighbors, n - 1)
+        dists, idx = dense_knn(x, x, k + 1, metric=mt, res=res)
+        rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k + 1)
+        cols = idx.reshape(-1).astype(jnp.int32)
+        vals = dists.reshape(-1).astype(jnp.float32)
+        keep = rows != cols
+        # canonicalize to (min, max) so asymmetric kNN membership still keeps
+        # the edge under mst()'s u<v filter; dedupe reciprocal pairs by max
+        lo = jnp.minimum(rows, cols)
+        hi = jnp.maximum(rows, cols)
+        coo = CooMatrix(
+            jnp.where(keep, lo, n), jnp.where(keep, hi, n),
+            jnp.where(keep, vals, 0.0), jnp.sum(keep.astype(jnp.int32)), (n, n),
+        )
+        graph = max_duplicates(sort_coo(coo))
+
+    out = mst(graph)
+
+    # repair forest → tree (knn graphs can be disconnected; ref detail/mst.cuh
+    # build_sorted_mst loop with connect_components)
+    for _ in range(32):
+        if int(out.n_edges) >= n - 1:
+            break
+        extra = connect_components(x, out.colors, res=res)
+        # connect_components emits squared-L2 weights; match the graph's units
+        extra_vals = extra.vals
+        if mt in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+            extra_vals = jnp.sqrt(jnp.maximum(extra_vals, 0.0))
+        merged = CooMatrix(
+            jnp.concatenate([out.src, extra.rows]),
+            jnp.concatenate([out.dst, extra.cols]),
+            jnp.concatenate([out.weights, extra_vals]),
+            out.n_edges + extra.nnz,
+            (n, n),
+        )
+        # re-pack valid entries: mst() masks on row<col, so canonicalize pairs;
+        # max dedupe keeps reciprocal winner edges at their true weight
+        valid = merged.rows < n
+        rows = jnp.where(valid, jnp.minimum(merged.rows, merged.cols), n)
+        cols = jnp.where(valid, jnp.maximum(merged.rows, merged.cols), n)
+        vals = jnp.where(valid & jnp.isfinite(merged.vals), merged.vals, 0.0)
+        packed = max_duplicates(sort_coo(CooMatrix(rows, cols, vals, merged.nnz, (n, n))))
+        out = mst(packed)
+
+    ne = int(out.n_edges)
+    src = np.asarray(out.src[:ne])
+    dst = np.asarray(out.dst[:ne])
+    w = np.asarray(out.weights[:ne])
+    children, deltas, sizes = build_dendrogram_host(src, dst, w, n)
+    labels = cut_tree_host(children, n, n_clusters)
+    return SingleLinkageOutput(
+        labels=jnp.asarray(labels), children=children, deltas=deltas,
+        sizes=sizes, n_clusters=n_clusters,
+    )

@@ -1,1 +1,8 @@
-"""raft_tpu.solver — raft/solver + raft/sparse/solver (S8-S9, K5). Under construction."""
+"""raft_tpu.solver — combinatorial/iterative solvers.
+
+Reference: raft/sparse/solver (MST S8, Lanczos S9) + raft/solver (LAP K5).
+"""
+
+from .mst import MstOutput, mst
+
+__all__ = ["MstOutput", "mst"]

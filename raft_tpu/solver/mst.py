@@ -1,0 +1,117 @@
+"""Minimum spanning tree / forest — Borůvka, batch-synchronous.
+
+Reference: raft/sparse/solver/mst_solver.cuh + detail/mst_{solver_inl,kernels,
+utils}.cuh — a CUDA Borůvka with per-supervertex min-edge kernels, color
+propagation, and alternating-tree cycle avoidance.
+
+TPU re-design: one `lax.while_loop` whose body is entirely dense vector ops:
+
+1. every edge's (weight, id) is pre-ranked once (a single argsort) so the
+   per-component argmin is a scatter-min of int32 ranks — float tie-break
+   issues disappear and both endpoint components deterministically agree on
+   the same cheapest connecting edge (the reference's `alteration` weight
+   jitter, detail/mst_utils.cuh, solves the same tie problem numerically);
+2. winner edges hook max-color → min-color (strictly decreasing ⇒ no cycles),
+   and colors converge by pointer jumping (log₂n fixed-count inner loop) —
+   the analogue of the reference's min_pair_colors + label propagation;
+3. terminates when no cross-component edge remains (spanning forest if the
+   graph is disconnected).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..sparse.types import CooMatrix, CsrMatrix
+
+__all__ = ["MstOutput", "mst"]
+
+
+class MstOutput(NamedTuple):
+    """Reference: Graph_COO output of mst_solver (mst_solver.cuh)."""
+
+    src: jax.Array  # (cap,) int32, padding = n
+    dst: jax.Array  # (cap,) int32
+    weights: jax.Array  # (cap,) f32, padding = +inf
+    n_edges: jax.Array  # int32 scalar
+    colors: jax.Array  # (n,) int32 final component labels
+
+
+@functools.partial(jax.jit, static_argnames=("n", "jump_steps"))
+def _boruvka(rows, cols, weights, valid, n: int, jump_steps: int):
+    cap = rows.shape[0]
+    big = jnp.int32(2**31 - 1)
+
+    # global (weight, id) rank per edge: unique int32 keys for argmin
+    order = jnp.argsort(jnp.where(valid, weights, jnp.inf), stable=True)
+    rank = jnp.zeros((cap,), jnp.int32).at[order].set(jnp.arange(cap, dtype=jnp.int32))
+
+    def cond(state):
+        _, _, again = state
+        return again
+
+    def body(state):
+        color, mst_mask, _ = state
+        cu = color[jnp.minimum(rows, n - 1)]
+        cv = color[jnp.minimum(cols, n - 1)]
+        cand = valid & (cu != cv)
+        key = jnp.where(cand, rank, big)
+        # per-component min outgoing rank, both directions
+        best = jnp.full((n,), big, jnp.int32)
+        best = best.at[jnp.where(cand, cu, n)].min(key, mode="drop")
+        best = best.at[jnp.where(cand, cv, n)].min(key, mode="drop")
+        winner = cand & ((rank == best[cu]) | (rank == best[cv]))
+        mst_mask = mst_mask | winner
+        # hook max-color -> min-color for winner edges
+        cmin = jnp.minimum(cu, cv)
+        cmax = jnp.maximum(cu, cv)
+        parent = jnp.arange(n, dtype=jnp.int32)
+        parent = parent.at[jnp.where(winner, cmax, n)].min(cmin, mode="drop")
+        # pointer jumping to roots (parent[c] <= c ⇒ converges, no cycles)
+        parent = lax.fori_loop(0, jump_steps, lambda _, p: p[p], parent)
+        color = parent[color]
+        again = jnp.any(cand)
+        return color, mst_mask, again
+
+    color0 = jnp.arange(n, dtype=jnp.int32)
+    mask0 = jnp.zeros((cap,), bool)
+    color, mst_mask, _ = lax.while_loop(cond, body, (color0, mask0, jnp.bool_(True)))
+
+    # compact MST edges to the front, sorted by weight (ref: single_linkage
+    # sorts the MST output, cluster/detail/mst.cuh sorted MST)
+    sort_key = jnp.where(mst_mask, weights, jnp.inf)
+    out_order = jnp.argsort(sort_key, stable=True)
+    kept = mst_mask[out_order]
+    src = jnp.where(kept, rows[out_order], n)
+    dst = jnp.where(kept, cols[out_order], n)
+    w = jnp.where(kept, weights[out_order], jnp.inf)
+    n_edges = jnp.sum(mst_mask.astype(jnp.int32))
+    return MstOutput(src, dst, w, n_edges, color)
+
+
+def mst(graph, n_vertices: int | None = None) -> MstOutput:
+    """Minimum spanning forest of an undirected weighted graph.
+
+    ``graph`` is a CooMatrix/CsrMatrix whose entries are (symmetric) edge
+    weights. Returns edges sorted ascending by weight, padding rows = n.
+
+    Reference: raft::sparse::solver::mst (sparse/solver/mst_solver.cuh).
+    """
+    if isinstance(graph, CsrMatrix):
+        from ..sparse.convert import csr_to_coo
+
+        graph = csr_to_coo(graph)
+    expects(graph.shape[0] == graph.shape[1], "graph must be square")
+    n = n_vertices or graph.shape[0]
+    # drop one direction of each symmetric pair (keep u < v) — Borůvka scans
+    # both endpoints of every edge anyway
+    keep = graph.valid_mask() & (graph.rows < graph.cols)
+    jump = max(int(math.ceil(math.log2(max(n, 2)))) + 1, 1)
+    return _boruvka(graph.rows, graph.cols, graph.vals.astype(jnp.float32), keep, n, jump)

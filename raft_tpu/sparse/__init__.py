@@ -30,6 +30,8 @@ from .op import (
     remove_zeros,
     slice_rows,
 )
+from .distance import pairwise_distance, csr_to_ell, SPARSE_SUPPORTED
+from .neighbors import knn, knn_graph, connect_components
 
 __all__ = [
     "CooMatrix",
@@ -59,4 +61,10 @@ __all__ = [
     "filter_entries",
     "remove_zeros",
     "slice_rows",
+    "pairwise_distance",
+    "csr_to_ell",
+    "SPARSE_SUPPORTED",
+    "knn",
+    "knn_graph",
+    "connect_components",
 ]

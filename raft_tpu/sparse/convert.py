@@ -68,7 +68,9 @@ def dense_to_coo(x: jax.Array, cap: int | None = None) -> CooMatrix:
     n, m = x.shape
     cap = n * m if cap is None else cap
     mask = (x != 0).ravel()
-    nnz = jnp.sum(mask).astype(jnp.int32)
+    # a cap below the true nonzero count keeps the first `cap` entries in
+    # row-major order; nnz is clamped so the container stays consistent
+    nnz = jnp.minimum(jnp.sum(mask), cap).astype(jnp.int32)
     flat = jnp.arange(n * m, dtype=jnp.int32)
     # stable partition: valid entries first, in row-major order
     order = jnp.argsort(~mask, stable=True)[:cap]

@@ -1,0 +1,170 @@
+"""Sparse pairwise distances.
+
+Reference: raft/sparse/distance/distance.cuh (pairwiseDistance dispatch,
+supported metric list :37-54) over the load-balanced COO SpMV
+(sparse/distance/detail/coo_spmv.cuh:49-126) with dense-shared-mem vs
+hash-table row strategies.
+
+TPU re-think: the MXU wants dense tiles, so instead of a two-strategy SpMV
+the rows are staged tile-by-tile from an ELL (fixed-width gather) layout into
+dense VMEM blocks and scored with the *same* metric math as the dense layer
+(distance/pairwise.py) — one code path for all 17 sparse-supported metrics,
+identical numerics dense vs sparse. Peak memory is (tile·d) for the staged
+block plus the (m·d) densified RHS; the row tile adapts to the workspace
+budget exactly like the dense path's _choose_tile
+(reference knn_brute_force.cuh:78 tile sizing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance import pairwise as _pw
+from ..distance.types import DistanceType, resolve_metric
+from .types import CsrMatrix
+
+__all__ = ["pairwise_distance", "csr_to_ell", "SPARSE_SUPPORTED"]
+
+_f32 = jnp.float32
+
+# reference: sparse/distance/distance.cuh:37-54 supported_distance list
+SPARSE_SUPPORTED = frozenset(
+    {
+        DistanceType.L2Expanded,
+        DistanceType.L2Unexpanded,
+        DistanceType.L2SqrtExpanded,
+        DistanceType.L2SqrtUnexpanded,
+        DistanceType.InnerProduct,
+        DistanceType.L1,
+        DistanceType.Canberra,
+        DistanceType.Linf,
+        DistanceType.LpUnexpanded,
+        DistanceType.JaccardExpanded,
+        DistanceType.CosineExpanded,
+        DistanceType.HellingerExpanded,
+        DistanceType.DiceExpanded,
+        DistanceType.CorrelationExpanded,
+        DistanceType.RusselRaoExpanded,
+        DistanceType.HammingUnexpanded,
+        DistanceType.JensenShannon,
+        DistanceType.KLDivergence,
+    }
+)
+
+
+def csr_to_ell(csr: CsrMatrix, width: int | None = None):
+    """CSR → fixed-width ELL (idx (n, w) padded with shape[1], val (n, w)).
+
+    The TPU-native sparse row layout: every row becomes a fixed-size gather,
+    the analogue of the reference's max-row-nnz bucketing in the dense-smem
+    SpMV strategy (coo_spmv_strategies/dense_smem_strategy.cuh).
+    """
+    n, m = csr.shape
+    deg = csr.indptr[1:] - csr.indptr[:-1]
+    w = int(width) if width is not None else int(jnp.max(deg)) if csr.cap else 0
+    w = max(w, 1)
+    pos = jnp.arange(csr.cap, dtype=jnp.int32)
+    rows = csr.row_ids()
+    within = pos - jnp.take(csr.indptr, jnp.minimum(rows, n))
+    ok = (rows < n) & (within < w)
+    flat = jnp.where(ok, rows * w + within, n * w)
+    idx = jnp.full((n * w,), m, jnp.int32).at[flat].set(csr.indices, mode="drop")
+    val = jnp.zeros((n * w,), csr.data.dtype).at[flat].set(csr.data, mode="drop")
+    return idx.reshape(n, w), val.reshape(n, w)
+
+
+def _densify(ell_idx, ell_val, d: int):
+    """(t, w) ELL rows → (t, d) dense block; padding (idx==d) lands in a
+    discard column."""
+    t = ell_idx.shape[0]
+    out = jnp.zeros((t, d + 1), _f32)
+    out = out.at[jnp.arange(t)[:, None], ell_idx].add(ell_val.astype(_f32))
+    return out[:, :d]
+
+
+def _dense_block(metric: DistanceType, metric_arg: float, xd, yd):
+    """Score a dense (t, d) block against dense (m, d) with the shared
+    dense-metric math (distance/pairwise.py functions)."""
+    if metric == DistanceType.L2Expanded:
+        return _pw._l2_expanded(xd, yd, sqrt=False)
+    if metric == DistanceType.L2SqrtExpanded:
+        return _pw._l2_expanded(xd, yd, sqrt=True)
+    if metric == DistanceType.CosineExpanded:
+        return _pw._cosine(xd, yd)
+    if metric == DistanceType.CorrelationExpanded:
+        return _pw._correlation(xd, yd)
+    if metric == DistanceType.InnerProduct:
+        return _pw._inner_product(xd, yd)
+    if metric == DistanceType.HellingerExpanded:
+        return _pw._hellinger(xd, yd)
+    if metric == DistanceType.RusselRaoExpanded:
+        return _pw._russelrao(xd, yd)
+    if metric == DistanceType.KLDivergence:
+        return _pw._kl_divergence(xd, yd)
+    if metric == DistanceType.JaccardExpanded:
+        return _pw._jaccard(xd, yd)
+    if metric == DistanceType.DiceExpanded:
+        return _pw._dice(xd, yd)
+    ew = {
+        DistanceType.L1: _pw._ew_l1,
+        DistanceType.L2Unexpanded: _pw._ew_l2(False),
+        DistanceType.L2SqrtUnexpanded: _pw._ew_l2(True),
+        DistanceType.Linf: _pw._ew_linf,
+        DistanceType.Canberra: _pw._ew_canberra,
+        DistanceType.LpUnexpanded: _pw._ew_lp(metric_arg),
+        DistanceType.HammingUnexpanded: _pw._ew_hamming,
+        DistanceType.JensenShannon: _pw._ew_jensenshannon,
+    }[metric]
+    return ew(xd[:, None, :], yd[None, :, :], None)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "tile", "d"))
+def _sparse_pairwise(xi, xv, yd, metric: DistanceType, metric_arg: float, tile: int, d: int):
+    n = xi.shape[0]
+    num = -(-n // tile)
+    pad = num * tile - n
+    if pad:
+        xi = jnp.pad(xi, ((0, pad), (0, 0)), constant_values=d)
+        xv = jnp.pad(xv, ((0, pad), (0, 0)))
+    xit = xi.reshape(num, tile, -1)
+    xvt = xv.reshape(num, tile, -1)
+
+    def per_tile(args):
+        ti, tv = args
+        xd = _densify(ti, tv, d)
+        return _dense_block(metric, metric_arg, xd, yd)
+
+    out = lax.map(per_tile, (xit, xvt))
+    return out.reshape(num * tile, yd.shape[0])[:n]
+
+
+def pairwise_distance(x: CsrMatrix, y: CsrMatrix | None = None, metric="euclidean",
+                      metric_arg: float = 2.0, res: Resources | None = None):
+    """All-pairs distances between CSR row sets (reference:
+    raft::sparse::distance::pairwiseDistance, sparse/distance/distance.cuh:60).
+
+    Returns an (n, m) float32 dense matrix, numerically identical to the dense
+    ``raft_tpu.distance.pairwise_distance`` on densified inputs.
+    """
+    res = res or default_resources()
+    mt = resolve_metric(metric)
+    expects(mt in SPARSE_SUPPORTED, "metric %s unsupported for sparse inputs", mt.name)
+    y = x if y is None else y
+    expects(x.shape[1] == y.shape[1], "feature dims must match: %d vs %d", x.shape[1], y.shape[1])
+    d = x.shape[1]
+    xi, xv = csr_to_ell(x)
+    yd = y.todense().astype(_f32)
+    # elementwise metrics broadcast (tile, m, d); GEMM-shaped ones only (tile, m)
+    ew = mt in (
+        DistanceType.L1, DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
+        DistanceType.Linf, DistanceType.Canberra, DistanceType.LpUnexpanded,
+        DistanceType.HammingUnexpanded, DistanceType.JensenShannon,
+    )
+    tile = _pw._choose_tile(x.shape[0], y.shape[0], d if ew else 1, res.workspace_bytes)
+    return _sparse_pairwise(xi, xv, yd, mt, float(metric_arg), tile, d)

@@ -1,0 +1,169 @@
+"""Sparse brute-force kNN and kNN-graph construction.
+
+Reference: raft/sparse/neighbors/knn.cuh (brute_force_knn — batched sparse
+pairwise distances + select_k with cross-batch merge) and
+raft/sparse/neighbors/knn_graph.cuh (knn_graph — kNN of a point set against
+itself emitted as a COO adjacency).
+
+TPU shape: the query side is processed in row tiles; each tile's distances
+come from the shared sparse-pairwise staging (sparse/distance.py) and feed
+directly into select_k — no cross-batch heap merge is needed because the full
+candidate row fits in the (tile, n) block the budget planner sized.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance import pairwise as _pw
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import _select_k
+from .distance import SPARSE_SUPPORTED, _dense_block, _densify, csr_to_ell
+from .types import CooMatrix, CsrMatrix
+
+__all__ = ["knn", "knn_graph", "connect_components"]
+
+_f32 = jnp.float32
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _cross_component_nn(x, colors, tile: int):
+    """For every point, its nearest neighbor of a *different* component
+    (squared L2), tiled over rows. Returns (dist (n,), idx (n,))."""
+    n, d = x.shape
+    xf = x.astype(_f32)
+    norms = jnp.sum(xf * xf, axis=1)
+    num = -(-n // tile)
+    pad = num * tile - n
+    xp = jnp.pad(xf, ((0, pad), (0, 0))) if pad else xf
+    cp = jnp.pad(colors, (0, pad), constant_values=-1) if pad else colors
+    np_ = jnp.pad(norms, (0, pad)) if pad else norms
+    xt = xp.reshape(num, tile, d)
+    ct = cp.reshape(num, tile)
+    nt = np_.reshape(num, tile)
+
+    def per_tile(args):
+        xb, cb, nb = args
+        d2 = nb[:, None] + norms[None, :] - 2.0 * (xb @ xf.T)
+        d2 = jnp.where(cb[:, None] == colors[None, :], jnp.inf, jnp.maximum(d2, 0.0))
+        j = jnp.argmin(d2, axis=1).astype(jnp.int32)
+        return jnp.take_along_axis(d2, j[:, None], axis=1)[:, 0], j
+
+    dv, di = lax.map(per_tile, (xt, ct, nt))
+    return dv.reshape(-1)[:n], di.reshape(-1)[:n]
+
+
+def connect_components(x, colors, res: Resources | None = None) -> CooMatrix:
+    """Minimum cross-component connecting edges (one per component).
+
+    Reference: raft::sparse::neighbors::connect_components
+    (sparse/neighbors/detail/connect_components.cuh — fused L2 1-NN over
+    points masked to other components, then per-component min edge). Used to
+    repair disconnected kNN-graph MSTs in single-linkage (SURVEY.md K3).
+
+    Returns a CooMatrix of (up to one-per-component) symmetric L2² edges.
+    """
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    colors = jnp.asarray(colors, jnp.int32)
+    n = x.shape[0]
+    tile = _pw._choose_tile(n, n, 1, (res.workspace_bytes))
+    dist, idx = _cross_component_nn(x, colors, tile)
+
+    # per-component argmin via (dist, src) rank trick
+    order = jnp.argsort(dist, stable=True)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    best = jnp.full((n,), 2**31 - 1, jnp.int32).at[colors].min(
+        jnp.where(jnp.isfinite(dist), rank, 2**31 - 1), mode="drop"
+    )
+    winner = jnp.isfinite(dist) & (rank == best[colors])
+    rows = jnp.where(winner, jnp.arange(n, dtype=jnp.int32), n)
+    cols = jnp.where(winner, idx, n)
+    vals = jnp.where(winner, dist, 0.0)
+    # compact winners to the front
+    corder = jnp.argsort(~winner, stable=True)
+    return CooMatrix(
+        rows[corder], cols[corder], vals[corder],
+        jnp.sum(winner.astype(jnp.int32)), (n, n),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "k", "tile", "d", "ascending"))
+def _sparse_knn(qi, qv, yd, metric: DistanceType, metric_arg: float, k: int, tile: int,
+                d: int, ascending: bool):
+    m = qi.shape[0]
+    num = -(-m // tile)
+    pad = num * tile - m
+    if pad:
+        qi = jnp.pad(qi, ((0, pad), (0, 0)), constant_values=d)
+        qv = jnp.pad(qv, ((0, pad), (0, 0)))
+    qit = qi.reshape(num, tile, -1)
+    qvt = qv.reshape(num, tile, -1)
+
+    def per_tile(args):
+        ti, tv = args
+        dists = _dense_block(metric, metric_arg, _densify(ti, tv, d), yd)
+        return _select_k(dists, None, k, ascending)
+
+    dv, di = lax.map(per_tile, (qit, qvt))
+    return (
+        dv.reshape(num * tile, k)[:m],
+        di.reshape(num * tile, k)[:m],
+    )
+
+
+def knn(dataset: CsrMatrix, queries: CsrMatrix, k: int, metric="euclidean",
+        metric_arg: float = 2.0, res: Resources | None = None):
+    """k nearest neighbors of sparse queries in a sparse dataset.
+
+    Reference: raft::sparse::neighbors::brute_force_knn
+    (sparse/neighbors/knn.cuh, detail/knn.cuh sparse_knn_t). Returns
+    (distances (m, k), indices (m, k)).
+    """
+    res = res or default_resources()
+    mt = resolve_metric(metric)
+    expects(mt in SPARSE_SUPPORTED, "metric %s unsupported for sparse inputs", mt.name)
+    expects(dataset.shape[1] == queries.shape[1], "feature dims must match")
+    expects(k <= dataset.shape[0], "k > dataset size")
+    d = dataset.shape[1]
+    qi, qv = csr_to_ell(queries)
+    yd = dataset.todense().astype(_f32)
+    ascending = mt != DistanceType.InnerProduct
+    ew = mt in (
+        DistanceType.L1, DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded,
+        DistanceType.Linf, DistanceType.Canberra, DistanceType.LpUnexpanded,
+        DistanceType.HammingUnexpanded, DistanceType.JensenShannon,
+    )
+    tile = _pw._choose_tile(queries.shape[0], dataset.shape[0], d if ew else 1, res.workspace_bytes)
+    return _sparse_knn(qi, qv, yd, mt, float(metric_arg), int(k), tile, d, ascending)
+
+
+def knn_graph(dataset: CsrMatrix, k: int, metric="euclidean",
+              res: Resources | None = None) -> CooMatrix:
+    """kNN graph of a sparse point set as COO (self edges excluded).
+
+    Reference: raft::sparse::neighbors::knn_graph
+    (sparse/neighbors/knn_graph.cuh — k+1 search, self-edge drop, COO emit).
+    """
+    n = dataset.shape[0]
+    expects(k + 1 <= n, "k + 1 > dataset size")
+    dists, idx = knn(dataset, dataset, k + 1, metric=metric, res=res)
+    # drop the self column: usually column 0, but ties may reorder — mask by id
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k + 1)
+    cols = idx.reshape(-1).astype(jnp.int32)
+    vals = dists.reshape(-1)
+    self_edge = rows == cols
+    # keep first k non-self edges per row via stable partition within rows
+    order = jnp.argsort(self_edge.reshape(n, k + 1), axis=1, stable=True)
+    cols2 = jnp.take_along_axis(cols.reshape(n, k + 1), order, axis=1)[:, :k]
+    vals2 = jnp.take_along_axis(vals.reshape(n, k + 1), order, axis=1)[:, :k]
+    rows2 = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    return CooMatrix(
+        rows2, cols2.reshape(-1), vals2.reshape(-1), jnp.int32(n * k), (n, n)
+    )

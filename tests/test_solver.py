@@ -1,0 +1,128 @@
+"""MST / connect_components / single-linkage tests.
+
+References: scipy.sparse.csgraph.minimum_spanning_tree for MST weight
+parity, scipy.cluster.hierarchy single linkage for HAC parity — the same
+trusted-host-result strategy as the reference's SOLVERS_TEST / CLUSTER_TEST
+gtests (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+import scipy.sparse.csgraph as csgraph
+from scipy.cluster.hierarchy import fcluster, linkage
+
+import jax.numpy as jnp
+
+from raft_tpu import sparse
+from raft_tpu.cluster import single_linkage
+from raft_tpu.solver import mst
+
+
+def _random_graph(rng, n, density=0.3, connected=True):
+    a = sps.random(n, n, density=density, random_state=np.random.RandomState(rng.integers(1 << 30)), format="csr", dtype=np.float32)
+    a.data = np.abs(a.data) + 0.01
+    a = (a + a.T) / 2  # symmetric
+    if connected:
+        # add a ring to guarantee connectivity
+        ring = sps.csr_matrix(
+            (np.full(n, 0.5, np.float32), (np.arange(n), (np.arange(n) + 1) % n)), shape=(n, n)
+        )
+        a = (a + ring + ring.T).tocsr()
+    a.setdiag(0)
+    a.eliminate_zeros()
+    return a.tocsr()
+
+
+class TestMst:
+    @pytest.mark.parametrize("n", [8, 30, 64])
+    def test_weight_matches_scipy(self, rng, n):
+        a = _random_graph(rng, n)
+        out = mst(sparse.from_scipy(a, cap=a.nnz + 5))
+        expect = csgraph.minimum_spanning_tree(a).sum()
+        ne = int(out.n_edges)
+        assert ne == n - 1
+        got = float(np.asarray(out.weights[:ne]).sum())
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_forest_on_disconnected(self, rng):
+        # two separate cliques => spanning forest with n-2 edges, 2 colors
+        n = 12
+        half = n // 2
+        d = np.zeros((n, n), np.float32)
+        d[:half, :half] = 1.0
+        d[half:, half:] = 2.0
+        np.fill_diagonal(d, 0.0)
+        csr = sparse.dense_to_csr(jnp.asarray(d))
+        out = mst(csr)
+        assert int(out.n_edges) == n - 2
+        colors = np.asarray(out.colors)
+        assert len(np.unique(colors)) == 2
+        assert len(np.unique(colors[:half])) == 1
+
+    def test_sorted_output(self, rng):
+        a = _random_graph(rng, 20)
+        out = mst(sparse.from_scipy(a))
+        ne = int(out.n_edges)
+        w = np.asarray(out.weights[:ne])
+        assert (np.diff(w) >= -1e-7).all()
+
+
+class TestConnectComponents:
+    def test_connects_two_blobs(self, rng):
+        x = np.concatenate([
+            rng.normal(0, 0.1, (10, 3)), rng.normal(5, 0.1, (8, 3))
+        ]).astype(np.float32)
+        colors = np.concatenate([np.zeros(10, np.int32), np.ones(8, np.int32)])
+        out = sparse.connect_components(jnp.asarray(x), jnp.asarray(colors))
+        ne = int(out.nnz)
+        assert ne >= 1
+        rows = np.asarray(out.rows[:ne])
+        cols = np.asarray(out.cols[:ne])
+        # every edge crosses the components
+        assert (colors[rows] != colors[cols]).all()
+
+
+class TestSingleLinkage:
+    @pytest.mark.parametrize("connectivity", ["pairwise", "knn"])
+    def test_matches_scipy_blobs(self, rng, connectivity):
+        # well-separated blobs: single-linkage must recover them exactly
+        centers = np.array([[0, 0], [10, 0], [0, 10]], np.float32)
+        x = np.concatenate([
+            rng.normal(c, 0.3, (20, 2)).astype(np.float32) for c in centers
+        ])
+        out = single_linkage(jnp.asarray(x), n_clusters=3, connectivity=connectivity, n_neighbors=5)
+        labels = np.asarray(out.labels)
+        expect = fcluster(linkage(x, method="single"), 3, criterion="maxclust")
+        # label sets must induce the same partition
+        for c in range(3):
+            members = labels == c
+            assert len(np.unique(expect[members])) == 1
+        assert len(np.unique(labels)) == 3
+
+    def test_dendrogram_deltas_match_scipy(self, rng):
+        x = rng.random((25, 4)).astype(np.float32)
+        out = single_linkage(jnp.asarray(x), n_clusters=1, connectivity="pairwise", metric="euclidean")
+        expect = linkage(x, method="single", metric="euclidean")
+        np.testing.assert_allclose(np.sort(out.deltas), np.sort(expect[:, 2]), rtol=1e-4)
+
+    def test_knn_euclidean_deltas_match_scipy(self, rng):
+        # random data: kNN membership is asymmetric, so this regresses the
+        # canonicalize-before-mst edge retention (i in knn(j) but not vice versa)
+        x = rng.random((40, 3)).astype(np.float32)
+        out = single_linkage(jnp.asarray(x), n_clusters=1, connectivity="knn",
+                             n_neighbors=15, metric="euclidean")
+        expect = linkage(x, method="single", metric="euclidean")
+        np.testing.assert_allclose(np.sort(out.deltas), np.sort(expect[:, 2]), rtol=1e-4)
+
+    def test_knn_repairs_disconnected_graph(self, rng):
+        # blobs far apart with tiny k: knn graph is disconnected; fixup must
+        # still produce a full tree and correct labels
+        x = np.concatenate([
+            rng.normal(0, 0.05, (15, 2)), rng.normal(100, 0.05, (15, 2))
+        ]).astype(np.float32)
+        out = single_linkage(jnp.asarray(x), n_clusters=2, connectivity="knn", n_neighbors=3)
+        labels = np.asarray(out.labels)
+        assert len(np.unique(labels[:15])) == 1
+        assert len(np.unique(labels[15:])) == 1
+        assert labels[0] != labels[15]
