@@ -4,7 +4,6 @@ Reference: cpp/include/raft/cluster/ (L4, K1-K3).
 """
 
 from . import kmeans, kmeans_balanced
-from . import single_linkage as _single_linkage_mod
 from .kmeans import KMeansOutput, KMeansParams
 from .kmeans_balanced import KMeansBalancedParams
 from .single_linkage import SingleLinkageOutput, single_linkage

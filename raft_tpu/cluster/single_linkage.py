@@ -130,7 +130,11 @@ def single_linkage(
     LinkageDistance {PAIRWISE, KNN_GRAPH} cluster/single_linkage_types.hpp).
     ``connectivity``: "pairwise" builds the complete graph; "knn" builds an
     n_neighbors graph and repairs disconnected components with
-    connect_components (the reference's KNN_GRAPH path).
+    connect_components (the reference's KNN_GRAPH path). As in the
+    reference, the knn path is an approximation: with small ``n_neighbors``
+    the kNN subgraph can be connected yet miss true-MST edges, so merge
+    heights may deviate slightly from exact single linkage — use
+    "pairwise" (or a larger ``n_neighbors``) for exact dendrograms.
     """
     res = res or default_resources()
     x = jnp.asarray(x)

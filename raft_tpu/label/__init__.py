@@ -1,1 +1,17 @@
-"""raft_tpu.label — raft/label (K6). Under construction."""
+"""raft_tpu.label — label utilities (reference: raft/label, K6 in SURVEY §2.6)."""
+
+from .classlabels import (
+    get_ovr_labels,
+    make_monotonic,
+    unique_labels,
+    unique_labels_padded,
+)
+from .merge_labels import merge_labels
+
+__all__ = [
+    "get_ovr_labels",
+    "make_monotonic",
+    "merge_labels",
+    "unique_labels",
+    "unique_labels_padded",
+]

@@ -1,0 +1,100 @@
+"""Class-label utilities.
+
+Reference: raft/label/classlabels.cuh — getUniquelabels (:41), getOvrlabels
+(:65, one-vs-rest binarization), make_monotonic (:91/:114, dense relabeling
+to a contiguous range, 1-based by default with optional ``zero_based``).
+
+TPU re-design: the reference sorts labels with CUB and compacts adjacent
+duplicates; here the same sort → adjacent-diff → prefix-sum pipeline is
+expressed in jnp so XLA owns the sort, and the per-element relabel is a
+``searchsorted`` into the sorted array instead of a binary-search kernel.
+``make_monotonic`` is fully jittable (static output shape); ``unique_labels``
+has a dynamic result size and therefore does a host round-trip, with a
+jittable padded variant ``unique_labels_padded`` for in-jit consumers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import expects
+
+__all__ = [
+    "unique_labels",
+    "unique_labels_padded",
+    "get_ovr_labels",
+    "make_monotonic",
+]
+
+
+def unique_labels(y):
+    """Sorted unique labels (reference: getUniquelabels, classlabels.cuh:41).
+
+    Dynamic output size ⇒ host round-trip; use :func:`unique_labels_padded`
+    inside jit.
+    """
+    return jnp.asarray(np.unique(np.asarray(y)))
+
+
+@jax.jit
+def unique_labels_padded(y):
+    """Jittable unique: (sorted_unique_padded, n_unique).
+
+    The output has the same length as ``y``; slots past ``n_unique`` hold the
+    maximum label (harmless for searchsorted-based relabeling).
+    """
+    y = y.ravel()
+    s = jnp.sort(y)
+    is_new = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    n_unique = jnp.sum(is_new, dtype=jnp.int32)
+    # stable-compact the firsts to the front, pad with the max label
+    pos = jnp.where(is_new, jnp.cumsum(is_new) - 1, y.shape[0] - 1)
+    out = jnp.full_like(s, s[-1]).at[pos].set(s, mode="drop")
+    # positions past n_unique may have been overwritten by the drop trick;
+    # re-fill them with the max label for determinism
+    out = jnp.where(jnp.arange(y.shape[0]) < n_unique, out, s[-1])
+    return out, n_unique
+
+
+def get_ovr_labels(y, unique, idx: int, one=1, zero=0):
+    """One-vs-rest binarize (reference: getOvrlabels, classlabels.cuh:65).
+
+    Labels equal to ``unique[idx]`` map to ``one``, everything else to
+    ``zero``.
+    """
+    expects(0 <= idx < unique.shape[0], "ovr index %d out of range [0, %d)", idx, unique.shape[0])
+    target = unique[idx]
+    return jnp.where(y == target, one, zero).astype(jnp.asarray(y).dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("zero_based",))
+def _make_monotonic(y, mask, zero_based: bool):
+    flat = y.ravel()
+    big = jnp.iinfo(flat.dtype).max if jnp.issubdtype(flat.dtype, jnp.integer) else jnp.inf
+    keyed = jnp.where(mask.ravel(), flat, big)  # filtered values sort to the back
+    s = jnp.sort(keyed)
+    is_new = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    dense = (jnp.cumsum(is_new) - 1).astype(jnp.int32)
+    pos = jnp.searchsorted(s, keyed)
+    out = dense[pos] + (0 if zero_based else 1)
+    out = jnp.where(mask.ravel(), out, flat.astype(jnp.int32))
+    return out.reshape(y.shape)
+
+
+def make_monotonic(y, filter_op=None, zero_based: bool = False):
+    """Relabel to a contiguous monotonic set (reference: make_monotonic,
+    classlabels.cuh:91).
+
+    Labels become ``1..n_classes`` (or ``0..n_classes-1`` when
+    ``zero_based``), ordered by label value. Elements for which
+    ``filter_op(label)`` is False keep their original value — the same
+    contract the reference uses to protect sentinel labels (e.g. DBSCAN's
+    untouched marker).
+    """
+    y = jnp.asarray(y)
+    mask = jnp.ones(y.shape, bool) if filter_op is None else filter_op(y)
+    return _make_monotonic(y, mask, bool(zero_based))

@@ -3,6 +3,7 @@
 Reference: raft/sparse/solver (MST S8, Lanczos S9) + raft/solver (LAP K5).
 """
 
+from .lap import LapOutput, lap_solve
 from .mst import MstOutput, mst
 
-__all__ = ["MstOutput", "mst"]
+__all__ = ["LapOutput", "MstOutput", "lap_solve", "mst"]

@@ -1,0 +1,144 @@
+"""Linear assignment problem (LAP), batched.
+
+Reference: raft/solver/linear_assignment.cuh — `LinearAssignmentProblem`
+(:54, ctor :88 takes (size, batchsize, epsilon), solve :119, dual/primal
+accessors :150-184) implementing the Date–Nagi GPU Hungarian algorithm
+(steps 0-6 in detail/lap_functions.cuh) over a batch of square cost
+matrices.
+
+TPU re-design: the Hungarian steps are branchy row/column covering with
+augmenting-path chases — hostile to XLA. The same optimum is reached by
+Bertsekas' **auction algorithm with ε-scaling**, whose bidding phase is a
+dense, batched top-2 reduction over the value matrix (MXU/VPU friendly) and
+whose assignment phase is two scatter rounds — all inside one
+`lax.while_loop`. Each round every unassigned person bids for its best
+object with increment (v₁−v₂+ε); prices only rise, so the loop terminates,
+and on completion the assignment is within n·ε of optimal (exact for
+integer costs once ε < 1/(n+1), the default). Prices are the column duals,
+matching the reference's getColDualVector; row duals are the residual max.
+Batch = `vmap`, replacing the reference's explicit batch loops.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+
+__all__ = ["LapOutput", "lap_solve"]
+
+_f32 = jnp.float32
+
+
+class LapOutput(NamedTuple):
+    """Reference accessors: getAssignmentVector/getRowAssignments (solve),
+    getRowDualVector :150, getColDualVector :160, getPrimalObjectiveValue :170."""
+
+    row_assignment: jax.Array  # (..., n) int32: column assigned to each row
+    col_assignment: jax.Array  # (..., n) int32: row assigned to each column
+    objective: jax.Array  # (...,) cost-sense objective value
+    row_duals: jax.Array  # (..., n) f32
+    col_duals: jax.Array  # (..., n) f32
+
+
+def _auction(benefit, eps_final: float, max_iter: int):
+    n = benefit.shape[0]
+    neg_inf = _f32(-jnp.inf)
+    rng = jnp.maximum(jnp.max(benefit) - jnp.min(benefit), 1.0)
+    eps0 = rng / 2.0
+
+    def cond(state):
+        _, _, _, _, done, it = state
+        return (~done) & (it < max_iter)
+
+    def body(state):
+        prices, row_assign, col_owner, eps, done, it = state
+        unassigned = row_assign < 0
+
+        values = benefit - prices[None, :]
+        top2_v, top2_i = lax.top_k(values, 2)
+        jstar = top2_i[:, 0]
+        # winning price the bidder is willing to pay for its best object
+        new_price = benefit[jnp.arange(n), jstar] - top2_v[:, 1] + eps
+
+        bid_to = jnp.where(unassigned, jstar, n)
+        colmax = jnp.full((n,), neg_inf, _f32).at[bid_to].max(
+            jnp.where(unassigned, new_price, neg_inf), mode="drop"
+        )
+        bided = colmax > neg_inf
+        is_winner = unassigned & (new_price >= colmax[jstar])
+        winner = jnp.full((n,), n, jnp.int32).at[bid_to].min(
+            jnp.where(is_winner, jnp.arange(n, dtype=jnp.int32), n), mode="drop"
+        )
+
+        # evict previous owners of columns that received bids, then assign
+        evicted = (row_assign >= 0) & bided[jnp.minimum(row_assign, n - 1)]
+        row_assign = jnp.where(evicted, -1, row_assign)
+        col_owner = jnp.where(bided, winner, col_owner)
+        row_assign = row_assign.at[jnp.where(bided, winner, n)].set(
+            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        )
+        prices = jnp.where(bided, colmax, prices)
+
+        all_assigned = jnp.all(row_assign >= 0)
+        at_final = eps <= eps_final
+        done = all_assigned & at_final
+        # ε-scaling: on completion of a scale, tighten ε and restart the
+        # assignment (prices are kept — that is what makes scaling fast)
+        rescale = all_assigned & ~at_final
+        eps = jnp.where(rescale, jnp.maximum(eps / 4.0, eps_final), eps)
+        row_assign = jnp.where(rescale, -1, row_assign)
+        col_owner = jnp.where(rescale, -1, col_owner)
+        return prices, row_assign, col_owner, eps, done, it + 1
+
+    state = (
+        jnp.zeros((n,), _f32),
+        jnp.full((n,), -1, jnp.int32),
+        jnp.full((n,), -1, jnp.int32),
+        jnp.maximum(eps0, _f32(eps_final)),
+        jnp.bool_(False),
+        jnp.int32(0),
+    )
+    prices, row_assign, col_owner, _, _, _ = lax.while_loop(cond, body, state)
+    row_duals = jnp.max(benefit - prices[None, :], axis=1)
+    return row_assign, col_owner, prices, row_duals
+
+
+def lap_solve(
+    cost,
+    eps: float | None = None,
+    maximize: bool = False,
+    max_iter: int | None = None,
+) -> LapOutput:
+    """Solve square assignment problems (reference: linear_assignment.cuh:119).
+
+    ``cost`` is ``(n, n)`` or batched ``(b, n, n)`` (the reference's
+    ``batchsize``). Minimizes by default. ``eps`` is the final auction
+    epsilon (reference ctor's ``epsilon``): the objective is within
+    ``n*eps`` of optimal, and exact for integer-valued costs with the
+    default ``1/(n+1)``.
+    """
+    cost = jnp.asarray(cost, _f32)
+    expects(cost.ndim in (2, 3), "cost must be (n,n) or (b,n,n), got %dd", cost.ndim)
+    n = cost.shape[-1]
+    expects(cost.shape[-2] == n, "cost matrices must be square")
+    if eps is None:
+        eps = 1.0 / (n + 1)
+    if max_iter is None:
+        # each round raises ≥1 price by ≥ε and prices are bounded ⇒ generous cap
+        max_iter = 2000 * n + 20_000
+
+    def solve_one(c):
+        benefit = c if maximize else -c
+        ra, ca, prices, rd = _auction(benefit, float(eps), int(max_iter))
+        obj = jnp.sum(c[jnp.arange(n), jnp.maximum(ra, 0)])
+        if not maximize:
+            prices, rd = -prices, -rd
+        return LapOutput(ra, ca, obj, rd, prices)
+
+    fn = jax.jit(solve_one if cost.ndim == 2 else jax.vmap(solve_one))
+    return fn(cost)

@@ -109,7 +109,8 @@ def mst(graph, n_vertices: int | None = None) -> MstOutput:
 
         graph = csr_to_coo(graph)
     expects(graph.shape[0] == graph.shape[1], "graph must be square")
-    n = n_vertices or graph.shape[0]
+    n = graph.shape[0] if n_vertices is None else n_vertices
+    expects(n >= graph.shape[0], "n_vertices=%d < graph dimension %d", n, graph.shape[0])
     # drop one direction of each symmetric pair (keep u < v) — Borůvka scans
     # both endpoints of every edge anyway
     keep = graph.valid_mask() & (graph.rows < graph.cols)

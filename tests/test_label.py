@@ -1,0 +1,94 @@
+"""Label utility tests.
+
+Reference strategy: cpp/test/label/label.cu (make_monotonic vs expected
+arrays) and cpp/test/label/merge_labels.cu (hand-built labellings with core
+masks and expected merged output) — SURVEY.md §4.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu import label
+
+
+class TestClassLabels:
+    def test_unique_labels(self, rng):
+        y = rng.integers(0, 10, 100)
+        got = np.asarray(label.unique_labels(jnp.asarray(y)))
+        np.testing.assert_array_equal(got, np.unique(y))
+
+    def test_unique_labels_padded(self, rng):
+        y = rng.integers(0, 7, 50).astype(np.int32)
+        padded, n_unique = label.unique_labels_padded(jnp.asarray(y))
+        ref = np.unique(y)
+        assert int(n_unique) == len(ref)
+        np.testing.assert_array_equal(np.asarray(padded)[: len(ref)], ref)
+
+    def test_make_monotonic_one_based(self):
+        y = jnp.asarray([5, 5, 12, 7, 12, 5])
+        out = np.asarray(label.make_monotonic(y))
+        np.testing.assert_array_equal(out, [1, 1, 3, 2, 3, 1])
+
+    def test_make_monotonic_zero_based(self, rng):
+        y = rng.choice([3, 17, 42, 99], 64)
+        out = np.asarray(label.make_monotonic(jnp.asarray(y), zero_based=True))
+        _, ref = np.unique(y, return_inverse=True)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_make_monotonic_filter(self):
+        # sentinel 99 must pass through untouched (reference filter_op contract)
+        y = jnp.asarray([10, 99, 20, 10, 99])
+        out = np.asarray(label.make_monotonic(y, filter_op=lambda v: v != 99))
+        np.testing.assert_array_equal(out, [1, 99, 2, 1, 99])
+
+    def test_ovr_labels(self):
+        y = jnp.asarray([2, 4, 4, 8, 2])
+        uniq = label.unique_labels(y)
+        out = np.asarray(label.get_ovr_labels(y, uniq, 1))
+        np.testing.assert_array_equal(out, [0, 1, 1, 0, 0])
+
+
+class TestMergeLabels:
+    MAX = np.iinfo(np.int32).max
+
+    def test_merge_basic(self):
+        # A: {0,1} {2,3}; B: {1,2} {3,4-ish} — mask merges everything via 1,2
+        la = jnp.asarray([1, 1, 3, 3], jnp.int32)
+        lb = jnp.asarray([1, 2, 2, 4], jnp.int32)
+        mask = jnp.asarray([True, True, True, True])
+        out = np.asarray(label.merge_labels(la, lb, mask))
+        np.testing.assert_array_equal(out, [1, 1, 1, 1])
+
+    def test_merge_respects_mask(self):
+        la = jnp.asarray([1, 1, 3, 3], jnp.int32)
+        lb = jnp.asarray([1, 3, 3, 3], jnp.int32)
+        mask = jnp.asarray([True, False, True, True])
+        out = np.asarray(label.merge_labels(la, lb, mask))
+        # point 1 is not core: its B label does not merge groups 1 and 3,
+        # but it still adopts min(R[la], R[lb]) like the reference reassign
+        np.testing.assert_array_equal(out, [1, 1, 3, 3])
+
+    def test_merge_vs_connected_components(self, rng):
+        # reference doc: merging CC labellings of G_A and G_B gives CC of the
+        # union graph — validate against scipy on random graphs
+        import scipy.sparse as sps
+        import scipy.sparse.csgraph as csgraph
+
+        n = 60
+        for seed in range(3):
+            r = np.random.default_rng(seed)
+            a = sps.random(n, n, density=0.02, random_state=seed, format="csr")
+            b = sps.random(n, n, density=0.02, random_state=seed + 100, format="csr")
+            _, ca = csgraph.connected_components(a + a.T, directed=False)
+            _, cb = csgraph.connected_components(b + b.T, directed=False)
+            _, cu = csgraph.connected_components(a + a.T + b + b.T, directed=False)
+            # canonical 1..N labelling: min vertex id + 1 per component
+            la = np.asarray([np.min(np.where(ca == ca[i])[0]) + 1 for i in range(n)], np.int32)
+            lb = np.asarray([np.min(np.where(cb == cb[i])[0]) + 1 for i in range(n)], np.int32)
+            out = np.asarray(
+                label.merge_labels(jnp.asarray(la), jnp.asarray(lb), jnp.ones(n, bool))
+            )
+            # same partition as the union graph's components
+            for i in range(n):
+                for j in range(n):
+                    assert (out[i] == out[j]) == (cu[i] == cu[j])

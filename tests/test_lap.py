@@ -1,0 +1,70 @@
+"""Linear assignment tests.
+
+Reference strategy: cpp/test/linalg (SOLVERS_TEST) checks LAP against known
+optimal objectives; here scipy.optimize.linear_sum_assignment is the trusted
+host reference (SURVEY.md §4) — exact parity on integer costs, objective
+parity within n·eps on floats.
+"""
+
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+import jax.numpy as jnp
+
+from raft_tpu.solver import lap_solve
+
+
+class TestLap:
+    @pytest.mark.parametrize("n", [4, 16, 64])
+    def test_integer_costs_exact(self, rng, n):
+        cost = rng.integers(0, 100, (n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost))
+        ri, ci = linear_sum_assignment(cost)
+        ref_obj = cost[ri, ci].sum()
+        ra = np.asarray(out.row_assignment)
+        assert sorted(ra.tolist()) == list(range(n))  # a permutation
+        assert float(out.objective) == pytest.approx(ref_obj)
+        assert cost[np.arange(n), ra].sum() == pytest.approx(ref_obj)
+
+    def test_float_costs_near_optimal(self, rng):
+        n = 48
+        cost = rng.random((n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost), eps=1e-4)
+        ri, ci = linear_sum_assignment(cost)
+        ref_obj = cost[ri, ci].sum()
+        assert float(out.objective) <= ref_obj + n * 1e-4 + 1e-4
+
+    def test_maximize(self, rng):
+        n = 24
+        cost = rng.integers(0, 50, (n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost), maximize=True)
+        ri, ci = linear_sum_assignment(cost, maximize=True)
+        assert float(out.objective) == pytest.approx(cost[ri, ci].sum())
+
+    def test_batched(self, rng):
+        b, n = 5, 20
+        cost = rng.integers(0, 100, (b, n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost))
+        assert out.row_assignment.shape == (b, n)
+        for i in range(b):
+            ri, ci = linear_sum_assignment(cost[i])
+            assert float(out.objective[i]) == pytest.approx(cost[i][ri, ci].sum())
+
+    def test_row_col_assignment_consistent(self, rng):
+        n = 32
+        cost = rng.integers(0, 100, (n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost))
+        ra, ca = np.asarray(out.row_assignment), np.asarray(out.col_assignment)
+        for i in range(n):
+            assert ca[ra[i]] == i
+
+    def test_duals_feasible(self, rng):
+        # complementary slackness (within eps): u_i + v_j <= c_ij + eps
+        n = 16
+        cost = rng.integers(0, 100, (n, n)).astype(np.float32)
+        out = lap_solve(jnp.asarray(cost))
+        u = np.asarray(out.row_duals)[:, None]
+        v = np.asarray(out.col_duals)[None, :]
+        eps = 1.0 / (n + 1)
+        assert np.all(u + v <= cost + eps + 1e-5)
