@@ -3,7 +3,20 @@
 Reference: raft/sparse/solver (MST S8, Lanczos S9) + raft/solver (LAP K5).
 """
 
+from .lanczos import (
+    compute_largest_eigenvectors,
+    compute_smallest_eigenvectors,
+    eigsh,
+)
 from .lap import LapOutput, lap_solve
 from .mst import MstOutput, mst
 
-__all__ = ["LapOutput", "MstOutput", "lap_solve", "mst"]
+__all__ = [
+    "LapOutput",
+    "MstOutput",
+    "compute_largest_eigenvectors",
+    "compute_smallest_eigenvectors",
+    "eigsh",
+    "lap_solve",
+    "mst",
+]

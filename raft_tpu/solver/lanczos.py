@@ -1,0 +1,200 @@
+"""Restarted Lanczos eigensolver (reference: raft/sparse/solver/lanczos.cuh
+computeSmallestEigenvectors:68 / computeLargestEigenvectors:132, detail in
+sparse/solver/detail/lanczos.cuh).
+
+TPU-first design: the reference runs implicitly-restarted Lanczos with scalar
+alpha/beta recurrences and host-side LAPACK on the tridiagonal system. Here we
+use *thick-restart* Lanczos with full two-pass reorthogonalization: every
+expansion step is two dense (n, m) GEMVs (``Vᵀw`` and ``V @ h``) that ride the
+MXU, the projected system is a small (m, m) symmetric matrix solved with
+``jnp.linalg.eigh`` on device, and the restart loop is a ``lax.while_loop`` so
+the whole solve is one XLA computation — no host round-trips per iteration.
+Full reorthogonalization costs 2x FLOPs vs the scalar recurrence but is what
+makes float32 viable (the reference needs periodic reorth too,
+detail/lanczos.cuh lanczosRestart) and the GEMV formulation is exactly what
+the hardware wants.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import Partial
+
+from ..core.errors import expects
+from ..random.rng import as_key
+from ..sparse.types import CsrMatrix
+
+__all__ = ["eigsh", "compute_smallest_eigenvectors", "compute_largest_eigenvectors"]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "max_restarts"))
+def _lanczos_thick_restart(matvec: Callable, v0: jax.Array, k: int, m: int,
+                           max_restarts: int, tol: jax.Array):
+    """Core thick-restart loop. Returns (eigenvalues (k,), eigenvectors (n, k),
+    n_restarts, residuals (k,)).
+
+    Basis buffer V is (n, m+1) with unbuilt columns zero, so the two-pass
+    Gram-Schmidt ``h = Vᵀw; w -= V h`` automatically restricts to the built
+    basis. H is the (m+1, m) projected matrix; after a restart it is
+    arrow-shaped (locked Ritz diag + coupling row), which the symmetrized
+    Ritz extraction handles uniformly.
+    """
+    n = v0.shape[0]
+    dtype = v0.dtype
+    eps = jnp.asarray(1e-30, dtype)
+    key = as_key(7)
+
+    def expand(V, H, j0, salt):
+        def body(j, carry):
+            V, H = carry
+
+            def do(V, H):
+                w = matvec(V[:, j])
+                h1 = V.T @ w
+                w = w - V @ h1
+                h2 = V.T @ w
+                w = w - V @ h2
+                h = h1 + h2
+                beta = jnp.linalg.norm(w)
+                # breakdown (invariant subspace): continue with a fresh
+                # orthonormalized random direction, coupling ~0
+                r = jax.random.normal(jax.random.fold_in(key, salt + j), (n,), dtype)
+                r = r - V @ (V.T @ r)
+                r = r / jnp.maximum(jnp.linalg.norm(r), eps)
+                ok = beta > jnp.asarray(1e-6, dtype) * jnp.maximum(
+                    jnp.linalg.norm(h), jnp.asarray(1.0, dtype))
+                vnext = jnp.where(ok, w / jnp.maximum(beta, eps), r)
+                h = h.at[j + 1].set(jnp.where(ok, beta, 0.0))
+                H2 = H.at[:, j].set(h)
+                V2 = V.at[:, j + 1].set(vnext)
+                return V2, H2
+
+            return lax.cond(j >= j0, do, lambda V, H: (V, H), V, H)
+
+        return lax.fori_loop(0, m, body, (V, H))
+
+    def ritz(H):
+        t = H[:m, :m]
+        t = (t + t.T) * 0.5
+        theta, s = jnp.linalg.eigh(t)  # ascending
+        res = jnp.abs(H[m, m - 1] * s[m - 1, :])
+        return theta, s, res
+
+    def cond(carry):
+        V, H, j0, r, done = carry
+        return jnp.logical_and(r < max_restarts, jnp.logical_not(done))
+
+    def step(carry):
+        V, H, j0, r, done = carry
+        V, H = expand(V, H, j0, r * (m + 1))
+        theta, s, res = ritz(H)
+        scale = jnp.maximum(jnp.max(jnp.abs(theta[:k])), jnp.asarray(1.0, dtype))
+        converged = jnp.max(res[:k]) < tol * scale
+        # thick restart: lock k Ritz vectors, keep the residual basis vector
+        locked = V[:, :m] @ s[:, :k]  # (n, k)
+        Vn = jnp.zeros_like(V)
+        Vn = Vn.at[:, :k].set(locked)
+        Vn = Vn.at[:, k].set(V[:, m])
+        Hn = jnp.zeros_like(H)
+        Hn = Hn.at[jnp.arange(k), jnp.arange(k)].set(theta[:k])
+        Hn = Hn.at[k, :k].set(H[m, m - 1] * s[m - 1, :k])
+        return Vn, Hn, k, r + 1, converged
+
+    V0 = jnp.zeros((n, m + 1), dtype).at[:, 0].set(v0)
+    H0 = jnp.zeros((m + 1, m), dtype)
+    V, H, _, n_restarts, _ = lax.while_loop(cond, step, (V0, H0, 0, 0, False))
+    # after a restart the locked block carries the answer directly
+    w = jnp.diagonal(H)[:k]
+    vecs = V[:, :k]
+    res = jnp.abs(H[k, :k])
+    return w, vecs, n_restarts, res
+
+
+def _csr_mv(a, x):
+    from ..sparse.linalg import spmv
+
+    return spmv(a, x)
+
+
+def _dense_mv(a, x):
+    return a @ x
+
+
+def _neg_mv(mv, x):
+    return -mv(x)
+
+
+def _as_matvec(a, n):
+    """Wrap the operator as a jax.tree_util.Partial so it crosses the jit
+    boundary as a pytree — module-level inner functions keep the jit cache
+    warm across calls with the same shapes."""
+    if isinstance(a, CsrMatrix):
+        expects(a.shape[0] == a.shape[1], "matrix must be square")
+        return Partial(_csr_mv, a), a.shape[0], a.dtype
+    if callable(a):
+        expects(n is not None, "n is required for a callable operator")
+        return (a if isinstance(a, Partial) else Partial(a)), int(n), jnp.float32
+    arr = jnp.asarray(a)
+    expects(arr.ndim == 2 and arr.shape[0] == arr.shape[1], "matrix must be square")
+    return Partial(_dense_mv, arr), arr.shape[0], arr.dtype
+
+
+def eigsh(a, k: int = 6, which: str = "SA", n: int | None = None,
+          ncv: int | None = None, max_iter: int = 4000, tol: float = 1e-6,
+          seed=42, v0=None):
+    """k extremal eigenpairs of a symmetric operator.
+
+    ``a`` may be a :class:`CsrMatrix`, a dense (n, n) array, or a matvec
+    callable (pass ``n``). ``which`` is ``"SA"`` (smallest algebraic, the
+    reference's computeSmallestEigenvectors) or ``"LA"`` (largest,
+    computeLargestEigenvectors — internally solved on ``-A``).
+
+    Returns ``(eigenvalues (k,), eigenvectors (n, k), n_restarts)`` with
+    eigenvalues ascending, mirroring scipy.sparse.linalg.eigsh.
+    """
+    matvec, n, dtype = _as_matvec(a, n)
+    dtype = jnp.promote_types(dtype, jnp.float32)
+    expects(1 <= k < n, "need 1 <= k < n")
+    expects(which in ("SA", "LA"), "which must be 'SA' or 'LA'")
+    m = ncv if ncv is not None else min(n - 1, max(2 * k + 8, 20))
+    m = max(m, k + 2)
+    expects(m <= n, "ncv must be <= n (matrix too small for this k/ncv)")
+    max_restarts = max(1, math.ceil(max(max_iter - m, 0) / max(m - k, 1)) + 1)
+
+    if which == "LA":
+        matvec = Partial(_neg_mv, matvec)
+
+    if v0 is None:
+        v0 = jax.random.normal(as_key(seed), (n,), dtype)
+    else:
+        v0 = jnp.asarray(v0, dtype)
+    v0 = v0 / jnp.linalg.norm(v0)
+
+    w, v, n_restarts, _ = _lanczos_thick_restart(matvec, v0, k, m, max_restarts,
+                                                 jnp.asarray(tol, dtype))
+    if which == "LA":
+        w = -w[::-1]
+        v = v[:, ::-1]
+    return w, v, n_restarts
+
+
+def compute_smallest_eigenvectors(a, k: int, max_iter: int = 4000,
+                                  restart_iter: int | None = None,
+                                  tol: float = 1e-6, seed=42, v0=None):
+    """Reference parity: raft/sparse/solver/lanczos.cuh:68."""
+    return eigsh(a, k=k, which="SA", ncv=restart_iter, max_iter=max_iter,
+                 tol=tol, seed=seed, v0=v0)
+
+
+def compute_largest_eigenvectors(a, k: int, max_iter: int = 4000,
+                                 restart_iter: int | None = None,
+                                 tol: float = 1e-6, seed=42, v0=None):
+    """Reference parity: raft/sparse/solver/lanczos.cuh:132."""
+    return eigsh(a, k=k, which="LA", ncv=restart_iter, max_iter=max_iter,
+                 tol=tol, seed=seed, v0=v0)

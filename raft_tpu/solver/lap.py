@@ -21,6 +21,7 @@ Batch = `vmap`, replacing the reference's explicit batch loops.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -43,6 +44,7 @@ class LapOutput(NamedTuple):
     objective: jax.Array  # (...,) cost-sense objective value
     row_duals: jax.Array  # (..., n) f32
     col_duals: jax.Array  # (..., n) f32
+    converged: jax.Array  # (...,) bool: every row assigned within max_iter
 
 
 def _auction(benefit, eps_final: float, max_iter: int):
@@ -103,9 +105,30 @@ def _auction(benefit, eps_final: float, max_iter: int):
         jnp.bool_(False),
         jnp.int32(0),
     )
-    prices, row_assign, col_owner, _, _, _ = lax.while_loop(cond, body, state)
+    prices, row_assign, col_owner, _, done, _ = lax.while_loop(cond, body, state)
     row_duals = jnp.max(benefit - prices[None, :], axis=1)
-    return row_assign, col_owner, prices, row_duals
+    return row_assign, col_owner, prices, row_duals, done
+
+
+def _solve_one(c, eps: float, max_iter: int, maximize: bool):
+    n = c.shape[-1]
+    benefit = c if maximize else -c
+    ra, ca, prices, rd, done = _auction(benefit, eps, max_iter)
+    # unassigned rows (only possible when not converged) contribute 0
+    obj = jnp.sum(jnp.where(ra >= 0, c[jnp.arange(n), jnp.maximum(ra, 0)], 0.0))
+    if not maximize:
+        prices, rd = -prices, -rd
+    return LapOutput(ra, ca, obj, rd, prices, done)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "max_iter", "maximize"))
+def _solve_jit(c, eps: float, max_iter: int, maximize: bool):
+    return _solve_one(c, eps, max_iter, maximize)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "max_iter", "maximize"))
+def _solve_batch_jit(c, eps: float, max_iter: int, maximize: bool):
+    return jax.vmap(lambda m: _solve_one(m, eps, max_iter, maximize))(c)
 
 
 def lap_solve(
@@ -120,25 +143,25 @@ def lap_solve(
     ``batchsize``). Minimizes by default. ``eps`` is the final auction
     epsilon (reference ctor's ``epsilon``): the objective is within
     ``n*eps`` of optimal, and exact for integer-valued costs with the
-    default ``1/(n+1)``.
+    default ``1/(n+1)``. ``converged`` in the output is False for problems
+    where the iteration cap was hit before every row was assigned — the
+    assignment for those is partial (-1 rows).
     """
     cost = jnp.asarray(cost, _f32)
     expects(cost.ndim in (2, 3), "cost must be (n,n) or (b,n,n), got %dd", cost.ndim)
     n = cost.shape[-1]
     expects(cost.shape[-2] == n, "cost matrices must be square")
+    if n == 1:
+        shape = cost.shape[:-2]
+        zero = jnp.zeros(shape + (1,), jnp.int32)
+        return LapOutput(zero, zero, cost[..., 0, 0],
+                         jnp.zeros(shape + (1,), _f32), jnp.zeros(shape + (1,), _f32),
+                         jnp.ones(shape, bool))
     if eps is None:
         eps = 1.0 / (n + 1)
     if max_iter is None:
         # each round raises ≥1 price by ≥ε and prices are bounded ⇒ generous cap
         max_iter = 2000 * n + 20_000
 
-    def solve_one(c):
-        benefit = c if maximize else -c
-        ra, ca, prices, rd = _auction(benefit, float(eps), int(max_iter))
-        obj = jnp.sum(c[jnp.arange(n), jnp.maximum(ra, 0)])
-        if not maximize:
-            prices, rd = -prices, -rd
-        return LapOutput(ra, ca, obj, rd, prices)
-
-    fn = jax.jit(solve_one if cost.ndim == 2 else jax.vmap(solve_one))
-    return fn(cost)
+    fn = _solve_jit if cost.ndim == 2 else _solve_batch_jit
+    return fn(cost, float(eps), int(max_iter), bool(maximize))

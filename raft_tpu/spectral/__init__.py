@@ -1,1 +1,25 @@
-"""raft_tpu.spectral — raft/spectral (K4). Under construction."""
+"""raft_tpu.spectral — spectral partitioning / modularity clustering (K4).
+
+Reference: raft/spectral/{partition,modularity_maximization,eigen_solvers,
+cluster_solvers}.cuh + matrix_wrappers.hpp.
+"""
+
+from .partition import (
+    ClusterSolverConfig,
+    EigenSolverConfig,
+    SpectralOutput,
+    analyze_modularity,
+    analyze_partition,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "ClusterSolverConfig",
+    "EigenSolverConfig",
+    "SpectralOutput",
+    "analyze_modularity",
+    "analyze_partition",
+    "modularity_maximization",
+    "partition",
+]
