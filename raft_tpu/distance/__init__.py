@@ -4,6 +4,8 @@ Reference: cpp/include/raft/distance/ (L4) + pylibraft.distance (L6).
 """
 
 from .fused_nn import fused_l2_nn, fused_l2_nn_argmin
+from .kernels import KernelParams, KernelType, gram_matrix, kernel_factory
+from .masked_nn import masked_l2_nn
 from .pairwise import distance, pairwise_distance
 from .types import DISTANCE_TYPES, SUPPORTED_DISTANCES, DistanceType, resolve_metric
 
@@ -16,4 +18,9 @@ __all__ = [
     "distance",
     "fused_l2_nn",
     "fused_l2_nn_argmin",
+    "masked_l2_nn",
+    "KernelType",
+    "KernelParams",
+    "gram_matrix",
+    "kernel_factory",
 ]

@@ -1,0 +1,117 @@
+"""Gram (kernel) matrices — linear / polynomial / tanh / RBF.
+
+Re-design of the reference's SVM-style kernel stack
+(cpp/include/raft/distance/detail/kernels/{gram_matrix.cuh,
+kernel_matrices.cuh, kernel_factory.cuh}; public header
+cpp/include/raft/distance/kernels.cuh). The reference evaluates a cuBLAS /
+cusparse GEMM and then launches an epilogue kernel per kernel type
+(polynomial_kernel / tanh_kernel / rbf kernel expansion,
+kernel_matrices.cuh). On TPU the GEMM rides the MXU and XLA fuses the
+epilogue into the matmul output — so each kernel is one fused expression.
+
+Sparse inputs are the framework's padded :class:`~raft_tpu.sparse.types.CsrMatrix`;
+they are densified before the GEMM (the output Gram matrix is dense anyway,
+so this bounds memory at O(m·d + m·n) — fine for the SVM-style workloads the
+reference targets, whose csr×dense / csr×csr overloads likewise produce a
+dense output via cusparse SpMM, gram_matrix.cuh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..sparse.types import CsrMatrix
+
+__all__ = ["KernelType", "KernelParams", "gram_matrix", "kernel_factory"]
+
+_f32 = jnp.float32
+
+
+class KernelType(enum.Enum):
+    """Mirrors raft::distance::kernels::KernelType (distance_types.hpp:88)."""
+
+    LINEAR = "linear"
+    POLYNOMIAL = "polynomial"
+    RBF = "rbf"
+    TANH = "tanh"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    """Mirrors raft::distance::kernels::KernelParams (distance_types.hpp:98)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def _as_dense(x):
+    if isinstance(x, CsrMatrix):
+        return x.todense().astype(_f32)
+    return jnp.asarray(x).astype(_f32)
+
+
+def _mxu_dot(x, y):
+    return lax.dot_general(
+        x,
+        y,
+        (((1,), (1,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=_f32,
+    )
+
+
+def gram_matrix(params: KernelParams, x, y=None, norm_x=None, norm_y=None):
+    """Evaluate the (m, n) Gram matrix K(x_i, y_j).
+
+    Reference: GramMatrixBase::evaluate / {Polynomial,Tanh,RBF}Kernel
+    (detail/kernels/kernel_matrices.cuh). ``x``/``y`` may be dense arrays or
+    padded CsrMatrix; ``y=None`` means K(x, x). ``norm_x``/``norm_y`` are
+    optional precomputed squared L2 row norms for the RBF expansion path
+    (the reference's rbf_fin_op receives them the same way).
+    """
+    xd = _as_dense(x)
+    yd = xd if y is None else _as_dense(y)
+    expects(xd.ndim == 2 and yd.ndim == 2, "gram inputs must be 2-D")
+    expects(xd.shape[1] == yd.shape[1], "feature dims must match")
+
+    dot = _mxu_dot(xd, yd)
+    k = params.kernel
+    if k == KernelType.LINEAR:
+        return dot
+    if k == KernelType.POLYNOMIAL:
+        # ref: polynomial_kernel — (gain·K + offset)^degree
+        return jnp.power(params.gamma * dot + params.coef0, params.degree)
+    if k == KernelType.TANH:
+        # ref: tanh_kernel — tanh(gain·K + offset)
+        return jnp.tanh(params.gamma * dot + params.coef0)
+    if k == KernelType.RBF:
+        # ref: rbf kernel expansion — exp(-gain·(‖x‖² + ‖y‖² − 2·K))
+        nx = jnp.sum(xd * xd, axis=1) if norm_x is None else jnp.asarray(norm_x, _f32)
+        ny = (
+            nx
+            if (y is None and norm_y is None)
+            else (jnp.sum(yd * yd, axis=1) if norm_y is None else jnp.asarray(norm_y, _f32))
+        )
+        d2 = jnp.maximum(nx[:, None] + ny[None, :] - 2.0 * dot, 0.0)
+        return jnp.exp(-params.gamma * d2)
+    raise ValueError(f"Kernel not implemented: {k}")
+
+
+def kernel_factory(params: KernelParams):
+    """Return ``f(x, y=None) -> K`` for the given params.
+
+    Mirrors KernelFactory::create (detail/kernels/kernel_factory.cuh:29),
+    which returns a GramMatrixBase* evaluator object.
+    """
+
+    def evaluate(x, y=None, norm_x=None, norm_y=None):
+        return gram_matrix(params, x, y, norm_x=norm_x, norm_y=norm_y)
+
+    return evaluate

@@ -3,9 +3,11 @@
 Reference: cpp/include/raft/neighbors/ (L4, N1-N10).
 """
 
-from . import brute_force, cagra, ivf_flat, ivf_pq
+from . import ball_cover, brute_force, cagra, ivf_flat, ivf_pq, sample_filter
 from .brute_force import BruteForce, knn, knn_merge_parts
+from .epsilon_neighborhood import eps_neighbors_l2sq
 from .refine import refine
+from .sample_filter import BitsetFilter, NoFilter
 
 __all__ = [
     "brute_force",
@@ -16,4 +18,9 @@ __all__ = [
     "knn",
     "knn_merge_parts",
     "refine",
+    "eps_neighbors_l2sq",
+    "ball_cover",
+    "sample_filter",
+    "BitsetFilter",
+    "NoFilter",
 ]

@@ -57,6 +57,11 @@ def plan_search_tiles(m: int, n_probes: int, k: int, capacity: int,
     (list payload + LUT etc.).
     """
     min_chunk = -(-k // capacity)
+    if min_chunk > n_probes:
+        raise ValueError(
+            f"k={k} exceeds the probed candidate pool "
+            f"(n_probes={n_probes} x capacity={capacity})"
+        )
     probe_chunk = n_probes
     query_tile = min(m, max_query_tile)
 

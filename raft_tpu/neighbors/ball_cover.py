@@ -1,0 +1,352 @@
+"""Random ball cover (RBC) — exact kNN with triangle-inequality pruning.
+
+Re-design of raft::neighbors::ball_cover (cpp/include/raft/neighbors/
+ball_cover-inl.cuh, ball_cover_types.hpp:34-110; kernels
+spatial/knn/detail/ball_cover.cuh and detail/ball_cover/registers-inl.cuh).
+The reference samples ``sqrt(n)`` landmarks, assigns every point to its
+closest landmark, and answers queries by scanning landmark lists in order of
+query→landmark distance, pruning lists whose lower bound
+``d(q, L) − radius(L)`` exceeds the current kth distance, with a
+post-processing pass that guarantees exactness.
+
+TPU shape: landmark lists live in the same padded (L, cap, d) layout as
+IVF-Flat, so a probe scan is a contiguous gather + MXU einsum. The
+batch-synchronous equivalent of the reference's per-query pruning loop is a
+two-pass search:
+
+1. probe the ``n_probes`` closest landmarks → per-query kth-distance bound u;
+2. host-round the *worst-case* number of lists any query still needs
+   (``d(q, L) − radius(L) ≤ u``, the reference's exactness condition) up to a
+   pow2 probe budget, and scan those lists, ranked by lower bound.
+
+Pass 2's budget is data-dependent but bucketed, so recompilation is rare; the
+result is exact for L2 metrics, like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance.pairwise import _choose_tile
+from ..distance.types import DistanceType, resolve_metric
+from ..matrix.select_k import _select_k
+from ._list_utils import assign_to_lists, list_positions, plan_search_tiles, round_up
+
+__all__ = ["BallCoverIndex", "build", "knn_query", "all_knn_query", "eps_nn_query"]
+
+_f32 = jnp.float32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BallCoverIndex:
+    """Reference: BallCoverIndex (neighbors/ball_cover_types.hpp:34) — raw
+    data, sampled landmarks, per-point landmark 1-NN, landmark ball radii."""
+
+    landmarks: jax.Array  # (L, d) f32
+    list_data: jax.Array  # (L, cap, d)
+    list_ids: jax.Array  # (L, cap) int32, -1 padding
+    list_norms: jax.Array  # (L, cap) f32, +inf padding
+    radii: jax.Array  # (L,) f32 — max member distance per landmark ball
+    metric: DistanceType
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.landmarks.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.landmarks.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.list_data.shape[1]
+
+    def tree_flatten(self):
+        return (
+            (self.landmarks, self.list_data, self.list_ids, self.list_norms, self.radii),
+            self.metric,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, metric, children):
+        return cls(*children, metric=metric)
+
+
+def build(dataset, metric="sqeuclidean", n_landmarks: int | None = None,
+          seed: int = 0, res: Resources | None = None) -> BallCoverIndex:
+    """Build the RBC index (reference: rbc_build_index,
+    spatial/knn/detail/ball_cover.cuh — sample sqrt(n) landmarks from the
+    dataset, 1-NN assign every point, sort points by landmark)."""
+    res = res or default_resources()
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, d)")
+    n, d = x.shape
+    mt = resolve_metric(metric)
+    expects(
+        mt in (
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.L2Unexpanded,
+            DistanceType.L2SqrtUnexpanded,
+            DistanceType.Haversine,
+        ),
+        "ball_cover supports L2 / haversine metrics, got %s",
+        mt.name,
+    )
+    L = n_landmarks or max(int(math.isqrt(n)), 1)
+    expects(L <= n, "n_landmarks > n_samples")
+
+    # uniform landmark sample without replacement (ref: rbc samples index rows)
+    key = jax.random.PRNGKey(seed)
+    perm = jax.random.permutation(key, n)[:L]
+    landmarks = x[perm].astype(_f32)
+
+    tile = _choose_tile(n, L, 1, res.workspace_bytes)
+    labels = assign_to_lists(x, landmarks, DistanceType.L2Expanded, tile)
+    sizes = jnp.bincount(labels, length=L)
+    capacity = round_up(max(int(jnp.max(sizes)), 1), 8)
+
+    pos, _ = list_positions(labels, L)
+    data = jnp.zeros((L, capacity, d), x.dtype).at[labels, pos].set(x)
+    ids = jnp.full((L, capacity), -1, jnp.int32).at[labels, pos].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    xf = x.astype(_f32)
+    norms = jnp.full((L, capacity), jnp.inf, _f32).at[labels, pos].set(
+        jnp.sum(xf * xf, axis=1)
+    )
+
+    # ball radius = max member distance (ref: R_radius, ball_cover.cuh
+    # computes it from the sorted 1-nn distances)
+    member_d = _true_dist(xf, landmarks[labels], mt)
+    radii = jnp.zeros((L,), _f32).at[labels].max(member_d)
+    return BallCoverIndex(landmarks, data, ids, norms, radii, mt)
+
+
+def _hav(lat1, lon1, lat2, lon2):
+    """Great-circle distance on broadcastable lat/lon radians (single home for
+    the formula; the pairwise-metric variant is _ew_haversine in
+    raft_tpu/distance/pairwise.py, which works on stacked (…, 2) tiles)."""
+    s1 = jnp.sin(0.5 * (lat2 - lat1))
+    s2 = jnp.sin(0.5 * (lon2 - lon1))
+    h = s1 * s1 + jnp.cos(lat1) * jnp.cos(lat2) * s2 * s2
+    return 2.0 * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+
+
+def _true_dist(a, b, metric: DistanceType):
+    """Rowwise distance in the index metric (a, b same shape)."""
+    if metric == DistanceType.Haversine:
+        return _hav(a[:, 0], a[:, 1], b[:, 0], b[:, 1])
+    d2 = jnp.maximum(jnp.sum(jnp.square(a - b), axis=-1), 0.0)
+    return jnp.sqrt(d2)
+
+
+def _q2l(queries, index: BallCoverIndex):
+    """Query→landmark *root* L2 (or haversine) distances — the triangle
+    inequality needs true metric distances, not squared."""
+    if index.metric == DistanceType.Haversine:
+        q = queries[:, None, :]
+        lm = index.landmarks[None, :, :]
+        return _hav(q[..., 0], q[..., 1], lm[..., 0], lm[..., 1])
+    qn = jnp.sum(queries * queries, axis=1)
+    ln = jnp.sum(index.landmarks * index.landmarks, axis=1)
+    # HIGHEST precision: this feeds the triangle-inequality exactness bound,
+    # which a bf16-default TPU matmul would corrupt
+    dots = lax.dot_general(
+        queries, index.landmarks, (((1,), (1,)), ((), ())),
+        precision=lax.Precision.HIGHEST, preferred_element_type=_f32,
+    )
+    d2 = qn[:, None] + ln[None, :] - 2.0 * dots
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric"))
+def _scan_lists(index: BallCoverIndex, queries, probes, n_probes: int, k: int,
+                query_tile: int, probe_chunk: int, metric: DistanceType):
+    """Scan the given (m, n_probes) landmark lists; returns root-metric
+    (dists, ids). Same tiled gather+einsum scan as IVF-Flat."""
+    m, d = queries.shape
+    qf = queries.astype(_f32)
+    num = -(-m // query_tile)
+    pad = num * query_tile - m
+    qp = jnp.pad(qf, ((0, pad), (0, 0))) if pad else qf
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qt = qp.reshape(num, query_tile, d)
+    pt = pp.reshape(num, query_tile, n_probes)
+    n_chunks = n_probes // probe_chunk
+    cap = index.capacity
+    haversine = metric == DistanceType.Haversine
+
+    def per_tile(args):
+        q, pr = args
+
+        def per_chunk(c, _):
+            pc = lax.dynamic_slice_in_dim(pr, c * probe_chunk, probe_chunk, axis=1)
+            vecs = index.list_data[pc].astype(_f32)  # (T, pc, cap, d)
+            ids = index.list_ids[pc]
+            if haversine:
+                qb = q[:, None, None, :]
+                scores = _hav(qb[..., 0], qb[..., 1], vecs[..., 0], vecs[..., 1])
+                scores = jnp.where(ids >= 0, scores, jnp.inf)
+            else:
+                dots = jnp.einsum("td,tpcd->tpc", q, vecs, precision=lax.Precision.HIGHEST)
+                scores = index.list_norms[pc] - 2.0 * dots  # +inf padding survives
+            flat_s = scores.reshape(query_tile, probe_chunk * cap)
+            flat_i = ids.reshape(query_tile, probe_chunk * cap)
+            return c + 1, _select_k(flat_s, flat_i, k, True)
+
+        _, (cv, ci) = lax.scan(per_chunk, 0, None, length=n_chunks)
+        cv = jnp.moveaxis(cv, 0, 1).reshape(query_tile, n_chunks * k)
+        ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
+        return _select_k(cv, ci, k, True)
+
+    dists, idx = lax.map(per_tile, (qt, pt))
+    dists = dists.reshape(num * query_tile, k)[:m]
+    idx = idx.reshape(num * query_tile, k)[:m]
+    if not haversine:
+        qn = jnp.sum(qf * qf, axis=1, keepdims=True)
+        dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(jnp.maximum(dists + qn, 0.0)), dists)
+    return dists, idx
+
+
+def knn_query(index: BallCoverIndex, queries, k: int, n_probes: int | None = None,
+              perform_post_filtering: bool = True, res: Resources | None = None):
+    """Exact kNN via the ball cover (reference: ball_cover::knn_query,
+    ball_cover-inl.cuh:259; exactness pass = perform_post_filtering).
+
+    Returns (distances, indices) in the index metric (sqeuclidean distances
+    are reported squared, matching the reference's L2 variants).
+    """
+    res = res or default_resources()
+    q = jnp.asarray(queries).astype(_f32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    m = q.shape[0]
+    L = index.n_landmarks
+    cap = index.capacity
+    expects(0 < k <= L * cap, "k=%d must be in (0, %d]", k, L * cap)
+
+    p1 = n_probes or min(L, max(2, -(-int(1.5 * k) // cap) + 1))
+    while p1 * cap < k:
+        p1 += 1
+    p1 = min(p1, L)
+
+    q2l = _q2l(q, index)  # (m, L) root distances
+    _, probes = _select_k(q2l, None, p1, True)
+
+    qt1, pc1 = plan_search_tiles(m, p1, int(k), cap,
+                                 bytes_per_probe_row=cap * index.dim * 4,
+                                 budget_bytes=res.workspace_bytes)
+    dists, idx = _scan_lists(index, q, probes, p1, int(k), qt1, pc1, index.metric)
+
+    if perform_post_filtering and L > p1:
+        # triangle-inequality exactness: list Lj can contain a better neighbor
+        # only if d(q, Lj) − radius(Lj) < current kth distance
+        # (ref: ball_cover.cuh perform_post_filtering_pass)
+        u = dists[:, -1]  # root-metric kth bound
+        lower = q2l - index.radii[None, :]
+        flagged = lower < u[:, None]  # lists that could still hold a neighbor
+        # pass 2 is needed iff any flagged list was NOT scanned in pass 1 —
+        # membership, not count: a far landmark with a big radius can be
+        # flagged while ranking below the p1 closest (probed) landmarks.
+        probed_mask = jnp.zeros((m, L), bool).at[
+            jnp.arange(m)[:, None], probes
+        ].set(True)
+        missing = jnp.any(flagged & ~probed_mask)
+        worst = int(jnp.max(jnp.sum(flagged, axis=1)))
+        if bool(missing):
+            # pass 2 must also satisfy the k <= p2*cap candidate-pool bound
+            need = max(worst, -(-k // cap))
+            p2 = min(L, 1 << max(need - 1, 1).bit_length())
+            _, probes2 = _select_k(lower, None, p2, True)
+            qt2, pc2 = plan_search_tiles(m, p2, int(k), cap,
+                                         bytes_per_probe_row=cap * index.dim * 4,
+                                         budget_bytes=res.workspace_bytes)
+            d2_, i2_ = _scan_lists(index, q, probes2, p2, int(k), qt2, pc2, index.metric)
+            # merge the two candidate sets
+            md = jnp.concatenate([dists, d2_], axis=1)
+            mi = jnp.concatenate([idx, i2_], axis=1)
+            # dedupe: same id may appear in both passes — push dups to +inf
+            order = jnp.argsort(md, axis=1)
+            mi_s = jnp.take_along_axis(mi, order, axis=1)
+            md_s = jnp.take_along_axis(md, order, axis=1)
+            w = md_s.shape[1]
+            earlier = jnp.tril(jnp.ones((w, w), bool), -1)
+            dup = jnp.any(
+                (mi_s[:, None, :] == mi_s[:, :, None]) & earlier[None], axis=2
+            )
+            md_s = jnp.where(dup, jnp.inf, md_s)
+            dists, idx = _select_k(md_s, mi_s, int(k), True)
+
+    if index.metric in (DistanceType.L2Expanded, DistanceType.L2Unexpanded):
+        dists = jnp.where(jnp.isfinite(dists), dists * dists, dists)
+    return dists, idx
+
+
+def all_knn_query(index: BallCoverIndex, k: int, res: Resources | None = None):
+    """kNN of the index points against themselves (reference:
+    ball_cover::all_knn_query, ball_cover-inl.cuh:112)."""
+    mask = index.list_ids.reshape(-1) >= 0
+    # reconstruct dataset rows in id order
+    flat = index.list_data.reshape(-1, index.dim)
+    ids = index.list_ids.reshape(-1)
+    n = int(jnp.sum(mask))
+    # padding slots scatter out-of-bounds and are dropped
+    x = jnp.zeros((n, index.dim), index.list_data.dtype)
+    x = x.at[jnp.where(mask, ids, n)].set(flat, mode="drop")
+    return knn_query(index, x, k, res=res)
+
+
+def eps_nn_query(index: BallCoverIndex, queries, eps: float, res: Resources | None = None):
+    """All neighbors within radius ``eps`` in the *index metric* (reference:
+    ball_cover::eps_nn, ball_cover-inl.cuh — adjacency output variant).
+    Returns (adj (m, n_rows) bool over global ids, vertex_degree (m+1,));
+    the exactness check ``dist ≤ eps`` subsumes the reference's landmark
+    pruning (a member of an unreachable list fails it by the triangle
+    inequality), so no per-slot reachability gather is needed. Query rows are
+    tiled under lax.map to respect the workspace budget."""
+    res = res or default_resources()
+    q = jnp.asarray(queries).astype(_f32)
+    expects(q.ndim == 2 and q.shape[1] == index.dim, "query dim mismatch")
+    m = q.shape[0]
+    flat = index.list_data.reshape(-1, index.dim).astype(_f32)
+    ids = index.list_ids.reshape(-1)
+    n_slots = flat.shape[0]
+    haversine = index.metric == DistanceType.Haversine
+
+    tile = _choose_tile(m, n_slots, 0, res.workspace_bytes)
+    num = -(-m // tile)
+    pad = num * tile - m
+    qp = jnp.pad(q, ((0, pad), (0, 0))) if pad else q
+
+    fn2 = jnp.sum(flat * flat, axis=1)
+
+    def per_tile(qb):
+        if haversine:
+            dist = _hav(
+                qb[:, None, 0], qb[:, None, 1], flat[None, :, 0], flat[None, :, 1]
+            )
+        else:
+            dots = lax.dot_general(
+                qb, flat, (((1,), (1,)), ((), ())),
+                precision=lax.Precision.HIGHEST, preferred_element_type=_f32,
+            )
+            d2 = jnp.sum(qb * qb, axis=1)[:, None] + fn2[None, :] - 2.0 * dots
+            dist = jnp.sqrt(jnp.maximum(d2, 0.0))
+        return (dist <= eps) & (ids >= 0)[None, :]
+
+    keep = lax.map(per_tile, qp.reshape(num, tile, index.dim))
+    keep = keep.reshape(num * tile, n_slots)[:m]
+    n = int(jnp.sum(ids >= 0))
+    adj = jnp.zeros((m, n), bool)
+    adj = adj.at[:, jnp.where(ids >= 0, ids, n)].max(keep, mode="drop")
+    deg = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    return adj, jnp.concatenate([deg, jnp.sum(deg, keepdims=True)])

@@ -28,7 +28,7 @@ __all__ = ["knn", "knn_merge_parts", "BruteForce"]
 
 
 @functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile", "inner_tile"))
-def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, tile: int, inner_tile: int):
+def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, tile: int, inner_tile: int, keep_mask=None):
     m = queries.shape[0]
     n = dataset.shape[0]
     # kNN ordering is identical under expanded vs unexpanded L2, so route the
@@ -43,18 +43,32 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, t
 
     def body(qb):
         d = _pairwise(qb, dataset, metric, metric_arg, inner_tile)  # (tile, n)
+        if keep_mask is not None:
+            # fused predicate filter (ref: neighbors/sample_filter_types.hpp)
+            d = jnp.where(keep_mask[None, :], d, jnp.inf if select_min else -jnp.inf)
         v = -d if select_min else d
         top_v, top_i = lax.top_k(v, k)
         return (-top_v if select_min else top_v), top_i.astype(jnp.int32)
 
     dists, idx = lax.map(body, qt)
-    return dists.reshape(num * tile, k)[:m], idx.reshape(num * tile, k)[:m]
+    dists = dists.reshape(num * tile, k)[:m]
+    idx = idx.reshape(num * tile, k)[:m]
+    if keep_mask is not None:
+        # when fewer than k rows pass the filter, top_k fills slots with
+        # ±inf scores carrying excluded ids — report those as -1
+        idx = jnp.where(jnp.isinf(dists), -1, idx)
+    return dists, idx
 
 
-def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0, res: Resources | None = None):
+def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
+        sample_filter=None, res: Resources | None = None):
     """Exact kNN of ``queries`` in ``dataset`` (reference:
     brute_force::knn, neighbors/brute_force.cuh; pylibraft
-    neighbors/brute_force.pyx knn). Returns (distances (m, k), indices (m, k))."""
+    neighbors/brute_force.pyx knn). ``sample_filter`` is an optional
+    :class:`~raft_tpu.neighbors.sample_filter.BitsetFilter` / boolean keep-mask
+    over dataset rows. Returns (distances (m, k), indices (m, k))."""
+    from .sample_filter import resolve_filter
+
     res = res or default_resources()
     dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)
@@ -63,11 +77,14 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     n = dataset.shape[0]
     expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
     mt = resolve_metric(metric)
+    keep_mask = resolve_filter(sample_filter)
+    if keep_mask is not None:
+        expects(keep_mask.shape == (n,), "sample filter must cover all %d dataset rows", n)
     # outer tile bounds the (tile, n) score block; inner tile bounds the
     # elementwise-metric broadcast within _pairwise
     tile = _choose_tile(queries.shape[0], n, 1, res.workspace_bytes)
     inner_tile = _choose_tile(tile, n, dataset.shape[1], res.workspace_bytes)
-    return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile)
+    return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile, keep_mask)
 
 
 def knn_merge_parts(part_dists, part_ids, k: int | None = None, select_min: bool = True):

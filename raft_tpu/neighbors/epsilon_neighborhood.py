@@ -1,0 +1,55 @@
+"""Epsilon neighborhood — all pairs within a radius.
+
+Re-design of raft::neighbors::epsilon_neighborhood::eps_neighbors_l2sq
+(cpp/include/raft/neighbors/epsilon_neighborhood.cuh; kernel in
+spatial/knn/detail/epsilon_neighborhood.cuh). The reference fuses a tiled
+L2² computation with the ≤ eps compare and a per-row popcount (vertex
+degree). On TPU the distance tile is an MXU GEMM and the compare + degree
+reduction fuse into its epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import expects
+
+__all__ = ["eps_neighbors_l2sq"]
+
+_f32 = jnp.float32
+
+
+@jax.jit
+def _eps_nn(x, y, eps_sq):
+    xf = x.astype(_f32)
+    yf = y.astype(_f32)
+    d2 = (
+        jnp.sum(xf * xf, axis=1)[:, None]
+        + jnp.sum(yf * yf, axis=1)[None, :]
+        - 2.0
+        * lax.dot_general(
+            xf, yf, (((1,), (1,)), ((), ())), precision=lax.Precision.HIGHEST,
+            preferred_element_type=_f32,
+        )
+    )
+    adj = jnp.maximum(d2, 0.0) <= eps_sq
+    deg = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    return adj, deg
+
+
+def eps_neighbors_l2sq(x, y=None, eps: float = 1.0):
+    """Boolean adjacency of all (x_i, y_j) pairs with ‖x_i − y_j‖² ≤ eps.
+
+    Reference: eps_neighbors_l2sq (neighbors/epsilon_neighborhood.cuh:78-105).
+    ``eps`` is the *squared* radius, as in the reference. Returns
+    ``(adj (m, n) bool, vertex_degree (m+1,) int32)`` where the final entry of
+    ``vertex_degree`` is the total edge count (the reference's ``vd + m``).
+    """
+    x = jnp.asarray(x)
+    y = x if y is None else jnp.asarray(y)
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1], "bad x/y shapes")
+    adj, deg = _eps_nn(x, y, _f32(eps))
+    vd = jnp.concatenate([deg, jnp.sum(deg, keepdims=True)])
+    return adj, vd

@@ -211,7 +211,8 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
     jax.jit, static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric")
 )
 def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
-                query_tile: int, probe_chunk: int, metric: DistanceType):
+                query_tile: int, probe_chunk: int, metric: DistanceType,
+                keep_mask=None):
     m, d = queries.shape
     qf = queries.astype(jnp.float32)
     inner = metric == DistanceType.InnerProduct
@@ -250,6 +251,10 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
             else:
                 norms = index.list_norms[pc]
                 scores = norms - 2.0 * dots  # +inf padding stays +inf
+            if keep_mask is not None:
+                from .sample_filter import apply_id_filter
+
+                scores = apply_id_filter(scores, ids, keep_mask, not inner)
             flat_s = scores.reshape(query_tile, probe_chunk * cap)
             flat_i = ids.reshape(query_tile, probe_chunk * cap)
             return c + 1, _select_k(flat_s, flat_i, k, not inner)
@@ -269,13 +274,22 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
         dists = jnp.where(jnp.isfinite(dists), jnp.maximum(dists + qn, 0.0), dists)
         if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
             dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(dists), dists)
+    if keep_mask is not None:
+        # filtered-out candidates carry ±inf scores — report id -1, matching
+        # the documented empty-slot sentinel
+        idx = jnp.where(jnp.isinf(dists), -1, idx)
     return dists, idx
 
 
-def search(params: SearchParams, index: IvfFlatIndex, queries, k: int, res: Resources | None = None):
+def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
+           sample_filter=None, res: Resources | None = None):
     """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh;
-    pylibraft neighbors/ivf_flat search). Returns (distances (m,k), ids (m,k));
-    id -1 marks slots beyond the probed candidate count."""
+    pylibraft neighbors/ivf_flat search; filtered overload
+    neighbors/ivf_flat.cuh search_with_filtering). Returns
+    (distances (m,k), ids (m,k)); id -1 marks slots beyond the probed
+    candidate count."""
+    from .sample_filter import resolve_filter
+
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
@@ -294,7 +308,13 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int, res: Reso
         budget_bytes=res.workspace_bytes,
     )
 
-    return _ivf_search(index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric)
+    keep_mask = resolve_filter(sample_filter)
+    if keep_mask is not None:
+        from .sample_filter import validate_filter_covers
+
+        validate_filter_covers(index, keep_mask)
+    return _ivf_search(index, queries, n_probes, int(k), query_tile, probe_chunk,
+                       index.metric, keep_mask)
 
 
 def save(index: IvfFlatIndex, path: str) -> None:

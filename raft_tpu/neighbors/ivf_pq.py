@@ -358,7 +358,8 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
                      "codebook_kind", "lut_bf16"),
 )
 def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: int,
-               probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_bf16: bool):
+               probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_bf16: bool,
+               keep_mask=None):
     m, d = queries.shape
     qf = queries.astype(jnp.float32)
     inner = metric == DistanceType.InnerProduct
@@ -435,6 +436,10 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
             scores = jnp.sum(gathered.astype(jnp.float32), axis=-1)  # (T, pc, cap)
             scores = scores + bias[:, :, None]
             scores = jnp.where(ids >= 0, scores, -jnp.inf if inner else jnp.inf)
+            if keep_mask is not None:
+                from .sample_filter import apply_id_filter
+
+                scores = apply_id_filter(scores, ids, keep_mask, not inner)
             flat_s = scores.reshape(query_tile, probe_chunk * cap)
             flat_i = ids.reshape(query_tile, probe_chunk * cap)
             return c + 1, _select_k(flat_s, flat_i, k, not inner)
@@ -449,14 +454,21 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
     idx = idx.reshape(num * query_tile, k)[:m]
     if not inner and metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(jnp.maximum(dists, 0.0)), dists)
+    if keep_mask is not None:
+        # filtered-out candidates carry ±inf scores — report id -1
+        idx = jnp.where(jnp.isinf(dists), -1, idx)
     return dists, idx
 
 
-def search(params: SearchParams, index: IvfPqIndex, queries, k: int, res: Resources | None = None):
-    """Search (reference: ivf_pq::search :723; pylibraft neighbors/ivf_pq).
+def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
+           sample_filter=None, res: Resources | None = None):
+    """Search (reference: ivf_pq::search :723; pylibraft neighbors/ivf_pq;
+    filtered overload neighbors/ivf_pq.cuh search_with_filtering).
 
     Returns (distances (m, k), ids (m, k)); distances are approximate
     (PQ-quantized), id -1 marks empty candidate slots."""
+    from .sample_filter import resolve_filter
+
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
@@ -476,9 +488,15 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int, res: Resour
         max_query_tile=128,
     )
 
+    keep_mask = resolve_filter(sample_filter)
+    if keep_mask is not None:
+        from .sample_filter import validate_filter_covers
+
+        validate_filter_covers(index, keep_mask)
     return _pq_search(
         index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
         index.codebook_kind, params.lut_dtype == "bfloat16",
+        keep_mask,
     )
 
 

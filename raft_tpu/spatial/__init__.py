@@ -1,0 +1,25 @@
+"""raft_tpu.spatial — legacy spatial::knn compatibility surface.
+
+Reference: cpp/include/raft/spatial/knn/ — the deprecated pre-``neighbors``
+API kept for source compatibility (spatial/knn/knn.cuh aliases into
+raft::neighbors). This package mirrors that: thin aliases plus the
+haversine kNN entry point (spatial/knn/detail/haversine_distance.cuh).
+"""
+
+from .knn import (
+    approx_knn_build_index,
+    approx_knn_search,
+    brute_force_knn,
+    haversine_knn,
+    knn,
+    select_k,
+)
+
+__all__ = [
+    "knn",
+    "brute_force_knn",
+    "haversine_knn",
+    "select_k",
+    "approx_knn_build_index",
+    "approx_knn_search",
+]

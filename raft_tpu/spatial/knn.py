@@ -1,0 +1,47 @@
+"""Deprecated spatial::knn aliases (reference: spatial/knn/knn.cuh,
+spatial/knn/ball_cover.cuh forwards, detail/haversine_distance.cuh)."""
+
+from __future__ import annotations
+
+from ..matrix.select_k import select_k  # noqa: F401  (spatial/knn/knn.cuh select_k alias)
+from ..neighbors.brute_force import knn as brute_force_knn  # noqa: F401
+
+# spatial::knn::knn was the original name of brute_force::knn
+knn = brute_force_knn
+
+
+def approx_knn_build_index(params, dataset, metric="sqeuclidean"):
+    """Legacy approximate-kNN entry (reference:
+    spatial/knn/detail/ann_quantized.cuh:42 approx_knn_build_index — a
+    dispatcher over IVF-Flat / IVF-PQ index params). ``params`` is an
+    ivf_flat.IndexParams or ivf_pq.IndexParams."""
+    import dataclasses
+
+    from ..neighbors import ivf_flat, ivf_pq
+
+    if isinstance(params, ivf_flat.IndexParams):
+        return ivf_flat.build(dataclasses.replace(params, metric=metric), dataset)
+    if isinstance(params, ivf_pq.IndexParams):
+        return ivf_pq.build(dataclasses.replace(params, metric=metric), dataset)
+    raise TypeError(f"unsupported legacy ANN params: {type(params)!r}")
+
+
+def approx_knn_search(index, queries, k: int, n_probes: int = 20):
+    """Legacy approximate-kNN search (reference: ann_quantized.cuh:96)."""
+    from ..neighbors import ivf_flat, ivf_pq
+
+    if isinstance(index, ivf_flat.IvfFlatIndex):
+        return ivf_flat.search(ivf_flat.SearchParams(n_probes=n_probes), index, queries, k)
+    if isinstance(index, ivf_pq.IvfPqIndex):
+        return ivf_pq.search(ivf_pq.SearchParams(n_probes=n_probes), index, queries, k)
+    raise TypeError(f"unsupported legacy ANN index: {type(index)!r}")
+
+
+def haversine_knn(dataset, queries, k: int):
+    """k nearest neighbors under the haversine great-circle metric.
+
+    Reference: raft::spatial::knn::detail::haversine_knn
+    (spatial/knn/detail/haversine_distance.cuh). Inputs are (n, 2) arrays of
+    (latitude, longitude) in radians.
+    """
+    return brute_force_knn(dataset, queries, k, metric="haversine")

@@ -1,0 +1,159 @@
+"""Ball cover + sample filter + legacy spatial API tests.
+
+Analogue of cpp/test/neighbors/ball_cover.cu (exactness vs brute force) and
+the filtered-search coverage in cpp/test/neighbors/ann_ivf_flat.cuh.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.neighbors import BitsetFilter, ball_cover, ivf_flat, knn
+from raft_tpu.spatial import approx_knn_build_index, approx_knn_search
+
+
+def _brute(x, q, k):
+    d2 = ((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1)
+    idx = np.argsort(d2, axis=1)[:, :k]
+    return np.take_along_axis(d2, idx, axis=1), idx
+
+
+def test_ball_cover_exact_small(rng):
+    n, d, m, k = 500, 8, 40, 7
+    x = rng.random((n, d)).astype(np.float32)
+    q = rng.random((m, d)).astype(np.float32)
+    index = ball_cover.build(x, metric="sqeuclidean", seed=3)
+    dists, idx = ball_cover.knn_query(index, q, k)
+    dists, idx = np.asarray(dists), np.asarray(idx)
+    want_d, _ = _brute(x, q, k)
+    # exactness: distances must match brute force (ids may tie-swap)
+    np.testing.assert_allclose(np.sort(dists, 1), np.sort(want_d, 1), atol=1e-3, rtol=1e-3)
+
+
+def test_ball_cover_all_knn(rng):
+    n, d, k = 300, 6, 5
+    x = rng.random((n, d)).astype(np.float32)
+    index = ball_cover.build(x, seed=1)
+    dists, idx = ball_cover.all_knn_query(index, k)
+    dists, idx = np.asarray(dists), np.asarray(idx)
+    want_d, _ = _brute(x, x, k)
+    np.testing.assert_allclose(np.sort(dists, 1), np.sort(want_d, 1), atol=1e-3, rtol=1e-3)
+    # nearest neighbor of each point is itself
+    assert (np.sort(dists, 1)[:, 0] < 1e-6).all()
+
+
+def test_ball_cover_haversine(rng):
+    n, m, k = 400, 20, 4
+    x = np.stack([rng.uniform(-1.2, 1.2, n), rng.uniform(-3, 3, n)], 1).astype(np.float32)
+    q = np.stack([rng.uniform(-1.2, 1.2, m), rng.uniform(-3, 3, m)], 1).astype(np.float32)
+    index = ball_cover.build(x, metric="haversine", seed=2)
+    dists, _ = ball_cover.knn_query(index, q, k)
+    dists = np.asarray(dists)
+
+    def hav(a, b):
+        s1 = np.sin(0.5 * (b[:, 0] - a[0]))
+        s2 = np.sin(0.5 * (b[:, 1] - a[1]))
+        return 2 * np.arcsin(np.sqrt(np.clip(s1**2 + np.cos(a[0]) * np.cos(b[:, 0]) * s2**2, 0, 1)))
+
+    for i in range(m):
+        want = np.sort(hav(q[i].astype(np.float64), x))[:k]
+        np.testing.assert_allclose(np.sort(dists[i]), want, atol=1e-4)
+
+
+def test_ball_cover_eps_nn(rng):
+    n, m = 250, 15
+    x = rng.random((n, 4)).astype(np.float32)
+    q = rng.random((m, 4)).astype(np.float32)
+    eps = 0.35
+    index = ball_cover.build(x, seed=4)
+    adj, vd = ball_cover.eps_nn_query(index, q, eps)
+    adj = np.asarray(adj)
+    d = np.sqrt(((q[:, None, :].astype(np.float64) - x[None, :, :]) ** 2).sum(-1))
+    want = d <= eps
+    np.testing.assert_array_equal(adj, want)
+    np.testing.assert_array_equal(np.asarray(vd)[:-1], want.sum(1))
+
+
+def test_ball_cover_clustered_exactness(rng):
+    # adversarial layout: tight clusters + one far-flung wide cluster whose
+    # landmark ranks below the probed set by center distance but is flagged by
+    # the triangle-inequality lower bound (post-filter membership regression)
+    c1 = rng.normal(0, 0.05, (150, 4)).astype(np.float32)
+    c2 = rng.normal(2, 0.05, (150, 4)).astype(np.float32) + np.array([3, 0, 0, 0], np.float32)
+    wide = (rng.normal(0, 2.5, (60, 4)) + np.array([1.5, 0, 0, 0])).astype(np.float32)
+    x = np.concatenate([c1, c2, wide])
+    q = rng.normal(1.5, 1.0, (25, 4)).astype(np.float32)
+    index = ball_cover.build(x, n_landmarks=12, seed=7)
+    dists, _ = ball_cover.knn_query(index, q, 6)
+    want_d, _ = _brute(x, q, 6)
+    np.testing.assert_allclose(np.sort(np.asarray(dists), 1), np.sort(want_d, 1), atol=1e-3, rtol=1e-3)
+
+
+def test_ball_cover_eps_nn_haversine(rng):
+    n, m = 200, 10
+    x = np.stack([rng.uniform(-1.2, 1.2, n), rng.uniform(-3, 3, n)], 1).astype(np.float32)
+    q = np.stack([rng.uniform(-1.2, 1.2, m), rng.uniform(-3, 3, m)], 1).astype(np.float32)
+    index = ball_cover.build(x, metric="haversine", seed=5)
+    adj, _ = ball_cover.eps_nn_query(index, q, eps=0.5)
+
+    def hav(a, b):
+        s1 = np.sin(0.5 * (b[:, 0] - a[0]))
+        s2 = np.sin(0.5 * (b[:, 1] - a[1]))
+        return 2 * np.arcsin(np.sqrt(np.clip(s1**2 + np.cos(a[0]) * np.cos(b[:, 0]) * s2**2, 0, 1)))
+
+    want = np.stack([hav(q[i].astype(np.float64), x) <= 0.5 for i in range(m)])
+    np.testing.assert_array_equal(np.asarray(adj), want)
+
+
+def test_filter_underfill_returns_sentinel(rng):
+    # fewer kept rows than k: excluded ids must NOT appear — slots are -1
+    n, m, k = 50, 4, 8
+    x = rng.random((n, 6)).astype(np.float32)
+    q = rng.random((m, 6)).astype(np.float32)
+    keep = np.zeros(n, bool)
+    keep[:3] = True  # only 3 candidates for k=8
+    dists, idx = knn(x, q, k, sample_filter=BitsetFilter(keep))
+    idx = np.asarray(idx)
+    valid = idx >= 0
+    assert valid.sum(axis=1).tolist() == [3] * m
+    assert keep[idx[valid]].all()
+    assert np.isinf(np.asarray(dists)[~valid]).all()
+
+
+def test_bitset_filter_brute_force(rng):
+    n, m, k = 200, 10, 5
+    x = rng.random((n, 16)).astype(np.float32)
+    q = rng.random((m, 16)).astype(np.float32)
+    keep = rng.random(n) > 0.5
+    dists, idx = knn(x, q, k, sample_filter=BitsetFilter(keep))
+    idx = np.asarray(idx)
+    assert keep[idx].all(), "filtered candidates leaked into results"
+    # equals brute force over the kept subset
+    sub = np.where(keep)[0]
+    want_d, want_i = _brute(x[sub], q, k)
+    np.testing.assert_allclose(np.sort(np.asarray(dists), 1), np.sort(want_d, 1), atol=1e-3, rtol=1e-3)
+
+
+def test_bitset_filter_ivf_flat(rng):
+    n, m, k = 600, 12, 6
+    x = rng.random((n, 10)).astype(np.float32)
+    q = rng.random((m, 10)).astype(np.float32)
+    keep = rng.random(n) > 0.3
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+    params = ivf_flat.SearchParams(n_probes=16)  # probe everything → exact
+    _, idx = ivf_flat.search(params, index, q, k, sample_filter=keep)
+    idx = np.asarray(idx)
+    valid = idx >= 0
+    assert keep[idx[valid]].all(), "filtered candidates leaked into IVF results"
+
+
+def test_legacy_approx_knn(rng):
+    n, m, k = 800, 30, 8
+    x = rng.random((n, 16)).astype(np.float32)
+    q = rng.random((m, 16)).astype(np.float32)
+    index = approx_knn_build_index(ivf_flat.IndexParams(n_lists=20, seed=0), x)
+    _, idx = approx_knn_search(index, q, k, n_probes=20)
+    _, want_i = _brute(x, q, k)
+    recall = np.mean([
+        len(set(np.asarray(idx)[i]) & set(want_i[i])) / k for i in range(m)
+    ])
+    assert recall > 0.99

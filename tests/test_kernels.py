@@ -1,0 +1,129 @@
+"""Gram/kernel matrix, masked NN, epsilon neighborhood, haversine kNN tests.
+
+Analogues of the reference's cpp/test/distance/gram.cu (+gram_base.cuh),
+test/distance/masked_nn.cu, test/neighbors/epsilon_neighborhood.cu and
+test/neighbors/haversine.cu fixtures: each result is compared against an
+independent numpy host reference.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance import KernelParams, KernelType, gram_matrix, kernel_factory, masked_l2_nn
+from raft_tpu.neighbors import eps_neighbors_l2sq
+from raft_tpu.sparse.types import from_scipy
+from raft_tpu.spatial import haversine_knn
+
+ATOL = 2e-4
+
+
+def _np_gram(params, x, y):
+    dot = x @ y.T
+    if params.kernel == KernelType.LINEAR:
+        return dot
+    if params.kernel == KernelType.POLYNOMIAL:
+        return (params.gamma * dot + params.coef0) ** params.degree
+    if params.kernel == KernelType.TANH:
+        return np.tanh(params.gamma * dot + params.coef0)
+    d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    return np.exp(-params.gamma * d2)
+
+
+KERNELS = [
+    KernelParams(KernelType.LINEAR),
+    KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.5, coef0=1.0),
+    KernelParams(KernelType.TANH, gamma=0.3, coef0=0.1),
+    KernelParams(KernelType.RBF, gamma=0.7),
+]
+
+
+@pytest.mark.parametrize("params", KERNELS, ids=[k.kernel.value for k in KERNELS])
+def test_gram_dense(rng, params):
+    x = rng.random((23, 11)).astype(np.float32)
+    y = rng.random((17, 11)).astype(np.float32)
+    got = np.asarray(gram_matrix(params, x, y))
+    want = _np_gram(params, x.astype(np.float64), y.astype(np.float64))
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=2e-4)
+
+
+def test_gram_self_and_factory(rng):
+    x = rng.random((15, 7)).astype(np.float32)
+    params = KernelParams(KernelType.RBF, gamma=1.3)
+    f = kernel_factory(params)
+    got = np.asarray(f(x))
+    want = _np_gram(params, x.astype(np.float64), x.astype(np.float64))
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=2e-4)
+    # self-gram diagonal of RBF is exactly 1
+    np.testing.assert_allclose(np.diag(got), 1.0, atol=1e-5)
+
+
+def test_gram_sparse_input(rng):
+    import scipy.sparse as sp
+
+    x = sp.random(20, 12, density=0.3, random_state=1, dtype=np.float32)
+    y = rng.random((9, 12)).astype(np.float32)
+    params = KernelParams(KernelType.POLYNOMIAL, degree=3, gamma=0.2, coef0=0.5)
+    got = np.asarray(gram_matrix(params, from_scipy(x.tocsr()), y))
+    want = _np_gram(params, x.toarray().astype(np.float64), y.astype(np.float64))
+    np.testing.assert_allclose(got, want, atol=ATOL, rtol=2e-4)
+
+
+def test_masked_l2_nn(rng):
+    m, n, d, g = 25, 40, 8, 4
+    x = rng.random((m, d)).astype(np.float32)
+    y = rng.random((n, d)).astype(np.float32)
+    # groups = 4 contiguous chunks of 10
+    group_ends = np.array([10, 20, 30, 40], np.int32)
+    adj = rng.random((m, g)) > 0.4
+
+    dists, idx = masked_l2_nn(x, y, adj, group_ends, sqrt=False)
+    dists, idx = np.asarray(dists), np.asarray(idx)
+
+    d2 = ((x[:, None, :].astype(np.float64) - y[None, :, :]) ** 2).sum(-1)
+    col_group = np.searchsorted(group_ends, np.arange(n), side="right")
+    mask = adj[:, col_group]
+    d2m = np.where(mask, d2, np.inf)
+    want_idx = d2m.argmin(1)
+    want_val = d2m.min(1)
+    none = ~mask.any(1)
+    assert np.all(idx[none] == -1) and np.all(np.isinf(dists[none]))
+    ok = ~none
+    np.testing.assert_array_equal(idx[ok], want_idx[ok])
+    np.testing.assert_allclose(dists[ok], want_val[ok], atol=ATOL, rtol=1e-4)
+
+
+def test_eps_neighbors(rng):
+    x = rng.random((30, 5)).astype(np.float32)
+    y = rng.random((22, 5)).astype(np.float32)
+    eps = 0.4  # squared radius
+    adj, vd = eps_neighbors_l2sq(x, y, eps=eps)
+    adj, vd = np.asarray(adj), np.asarray(vd)
+    d2 = ((x[:, None, :].astype(np.float64) - y[None, :, :]) ** 2).sum(-1)
+    want = d2 <= eps
+    np.testing.assert_array_equal(adj, want)
+    np.testing.assert_array_equal(vd[:-1], want.sum(1))
+    assert vd[-1] == want.sum()
+
+
+def test_haversine_knn(rng):
+    n, m, k = 50, 8, 5
+    pts = np.stack(
+        [rng.uniform(-np.pi / 2, np.pi / 2, n), rng.uniform(-np.pi, np.pi, n)], axis=1
+    ).astype(np.float32)
+    q = np.stack(
+        [rng.uniform(-np.pi / 2, np.pi / 2, m), rng.uniform(-np.pi, np.pi, m)], axis=1
+    ).astype(np.float32)
+
+    dists, idx = haversine_knn(pts, q, k)
+    dists, idx = np.asarray(dists), np.asarray(idx)
+
+    def hav(a, b):
+        s1 = np.sin(0.5 * (b[:, 0] - a[0]))
+        s2 = np.sin(0.5 * (b[:, 1] - a[1]))
+        h = s1**2 + np.cos(a[0]) * np.cos(b[:, 0]) * s2**2
+        return 2 * np.arcsin(np.sqrt(np.clip(h, 0, 1)))
+
+    for i in range(m):
+        all_d = hav(q[i].astype(np.float64), pts.astype(np.float64))
+        want = np.sort(all_d)[:k]
+        np.testing.assert_allclose(np.sort(dists[i]), want, atol=1e-4)
