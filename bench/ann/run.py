@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""raft_tpu ANN benchmark harness.
+
+Re-design of the reference's standalone ANN benchmark
+(cpp/bench/ann/src/common/benchmark.hpp — build mode :111, search mode :168;
+JSON configs cpp/bench/ann/conf/*.json; QPS-vs-recall workflow
+docs/source/cuda_ann_benchmarks.md). Same JSON schema shape: a ``dataset``
+section (big-ANN .fbin/.u8bin files via the native runtime loader, or a
+``synthetic`` spec so the harness runs hermetically), ``search_basic_param``
+(batch_size, k, run_count), and an ``index`` list with ``build_param`` +
+``search_params`` sweeps.
+
+Usage:
+  python bench/ann/run.py --conf bench/ann/conf/synthetic-64.json --build
+  python bench/ann/run.py --conf bench/ann/conf/synthetic-64.json --search
+  # or both passes in one go:
+  python bench/ann/run.py --conf ... --build --search
+
+Outputs one CSV row per (index, search_param): algo, params, build_s,
+recall@k, qps — written to ``results/<dataset>.csv`` next to the conf file
+and echoed to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+# honor JAX_PLATFORMS even when a sitecustomize pre-imported jax and
+# registered an accelerator backend (env vars alone are read too early)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def load_dataset(spec: dict):
+    """Return (base (n,d) f32, queries (m,d) f32, metric str)."""
+    import numpy as np
+
+    metric = spec.get("distance", "euclidean")
+    metric = {"euclidean": "sqeuclidean", "inner": "inner_product"}.get(metric, metric)
+    if "synthetic" in spec:
+        syn = spec["synthetic"]
+        rng = np.random.default_rng(syn.get("seed", 0))
+        base = rng.random((syn["n"], syn["dim"]), np.float32)
+        queries = rng.random((syn["n_queries"], syn["dim"]), np.float32)
+        return base, queries, metric
+    from raft_tpu.runtime import load_bin
+
+    base = load_bin(spec["base_file"]).astype(np.float32)
+    queries = load_bin(spec["query_file"]).astype(np.float32)
+    if "subset_size" in spec:
+        base = base[: spec["subset_size"]]
+    return base, queries, metric
+
+
+def ground_truth(base, queries, k: int, metric: str, cache: pathlib.Path):
+    import numpy as np
+
+    if cache.exists():
+        gt = np.load(cache)
+        if gt.shape == (queries.shape[0], k):
+            return gt
+    from raft_tpu.neighbors import knn
+
+    _, idx = knn(base, queries, k, metric=metric)
+    gt = np.asarray(idx)
+    cache.parent.mkdir(parents=True, exist_ok=True)
+    np.save(cache, gt)
+    return gt
+
+
+def recall(found, gt) -> float:
+    import numpy as np
+
+    m, k = gt.shape
+    hits = 0
+    for i in range(m):
+        hits += len(set(found[i].tolist()) & set(gt[i].tolist()))
+    return hits / (m * k)
+
+
+# ---------------------------------------------------------------------------
+# Algorithm wrappers (the reference's per-library src/<algo> adapters)
+# ---------------------------------------------------------------------------
+
+
+class Algo:
+    """build(dataset) -> index state; search(queries, k, params) -> ids."""
+
+    def __init__(self, metric: str, build_param: dict):
+        self.metric = metric
+        self.build_param = build_param
+
+    def build(self, dataset):
+        raise NotImplementedError
+
+    def search(self, queries, k: int, params: dict):
+        raise NotImplementedError
+
+
+class BruteForceAlgo(Algo):
+    def build(self, dataset):
+        import jax.numpy as jnp
+
+        self.dataset = jnp.asarray(dataset)
+
+    def search(self, queries, k, params):
+        from raft_tpu.neighbors import knn
+
+        return knn(self.dataset, queries, k, metric=self.metric)[1]
+
+
+class IvfFlatAlgo(Algo):
+    def build(self, dataset):
+        from raft_tpu.neighbors import ivf_flat
+
+        params = ivf_flat.IndexParams(metric=self.metric, **self.build_param)
+        self.index = ivf_flat.build(params, dataset)
+
+    def search(self, queries, k, params):
+        from raft_tpu.neighbors import ivf_flat
+
+        return ivf_flat.search(ivf_flat.SearchParams(**params), self.index, queries, k)[1]
+
+
+class IvfPqAlgo(Algo):
+    def build(self, dataset):
+        from raft_tpu.neighbors import ivf_pq
+
+        params = ivf_pq.IndexParams(metric=self.metric, **self.build_param)
+        self.index = ivf_pq.build(params, dataset)
+
+    def search(self, queries, k, params):
+        from raft_tpu.neighbors import ivf_pq
+
+        refine_ratio = params.pop("refine_ratio", 1)
+        sp = ivf_pq.SearchParams(**params)
+        if refine_ratio > 1:
+            from raft_tpu.neighbors import refine
+
+            d, i = ivf_pq.search(sp, self.index, queries, k * refine_ratio)
+            return refine(self._dataset, queries, i, k, metric=self.metric)[1]
+        return ivf_pq.search(sp, self.index, queries, k)[1]
+
+    def build_and_keep(self, dataset):
+        self._dataset = dataset
+
+
+class CagraAlgo(Algo):
+    def build(self, dataset):
+        from raft_tpu.neighbors import cagra
+
+        params = cagra.IndexParams(metric=self.metric, **self.build_param)
+        self.index = cagra.build(params, dataset)
+
+    def search(self, queries, k, params):
+        from raft_tpu.neighbors import cagra
+
+        return cagra.search(cagra.SearchParams(**params), self.index, queries, k)[1]
+
+
+class BallCoverAlgo(Algo):
+    def build(self, dataset):
+        from raft_tpu.neighbors import ball_cover
+
+        self.index = ball_cover.build(dataset, metric=self.metric, **self.build_param)
+
+    def search(self, queries, k, params):
+        from raft_tpu.neighbors import ball_cover
+
+        return ball_cover.knn_query(self.index, queries, k, **params)[1]
+
+
+ALGOS = {
+    "raft_tpu.brute_force": BruteForceAlgo,
+    "raft_tpu.ivf_flat": IvfFlatAlgo,
+    "raft_tpu.ivf_pq": IvfPqAlgo,
+    "raft_tpu.cagra": CagraAlgo,
+    "raft_tpu.ball_cover": BallCoverAlgo,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--conf", required=True, help="JSON config path")
+    ap.add_argument("--build", action="store_true")
+    ap.add_argument("--search", action="store_true")
+    ap.add_argument("--index-filter", default=None,
+                    help="only run index entries whose name contains this substring")
+    args = ap.parse_args()
+    if not (args.build or args.search):
+        ap.error("pass --build and/or --search")
+
+    import jax
+    import numpy as np
+
+    conf_path = pathlib.Path(args.conf)
+    conf = json.loads(conf_path.read_text())
+    out_dir = conf_path.parent.parent / "results"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    base, queries, metric = load_dataset(conf["dataset"])
+    basic = conf.get("search_basic_param", {})
+    k = basic.get("k", 10)
+    run_count = basic.get("run_count", 3)
+    batch_size = min(basic.get("batch_size", len(queries)), len(queries))
+    queries = queries[:batch_size]
+
+    gt = None
+    rows = []
+    built = {}
+
+    entries = conf["index"]
+    if args.index_filter:
+        entries = [e for e in entries if args.index_filter in e["name"]]
+
+    for entry in entries:
+        name, algo_id = entry["name"], entry["algo"]
+        if algo_id not in ALGOS:
+            print(f"[skip] {name}: unknown algo {algo_id}", file=sys.stderr)
+            continue
+        algo = ALGOS[algo_id](metric, entry.get("build_param", {}))
+        build_s = float("nan")
+        if args.build or args.search:  # build in-process (indexes are pytrees)
+            t0 = time.perf_counter()
+            algo.build(base)
+            if hasattr(algo, "build_and_keep"):
+                algo.build_and_keep(base)
+            build_s = time.perf_counter() - t0
+            built[name] = build_s
+            print(f"[build] {name}: {build_s:.2f}s")
+        if not args.search:
+            continue
+        if gt is None:
+            # cache key covers everything that changes the true neighbors
+            gt = ground_truth(
+                base, queries, k, metric,
+                out_dir / (
+                    f"gt-{conf['dataset']['name']}-{metric}-n{base.shape[0]}"
+                    f"-d{base.shape[1]}-q{len(queries)}-k{k}.npy"
+                ),
+            )
+        for sp in entry.get("search_params", [{}]):
+            sp_label = json.dumps(sp, sort_keys=True)
+            try:
+                ids = algo.search(queries, k, dict(sp))  # warmup/compile
+                jax.block_until_ready(ids)
+                times = []
+                for _ in range(run_count):
+                    t0 = time.perf_counter()
+                    ids = algo.search(queries, k, dict(sp))
+                    jax.block_until_ready(ids)
+                    times.append(time.perf_counter() - t0)
+                qps = len(queries) / min(times)
+                rec = recall(np.asarray(ids), gt)
+            except Exception as e:  # parameter combos can be invalid (k > pool)
+                print(f"[error] {name} {sp_label}: {e}", file=sys.stderr)
+                continue
+            rows.append({
+                "name": name, "algo": algo_id, "search_params": sp_label,
+                "k": k, "batch_size": len(queries), "build_s": round(build_s, 3),
+                f"recall@{k}": round(rec, 4), "qps": round(qps, 1),
+            })
+            print(f"[search] {name} {sp_label}: recall@{k}={rec:.4f} qps={qps:.1f}")
+
+    if rows:
+        out_csv = out_dir / f"{conf['dataset']['name']}.csv"
+        with open(out_csv, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            w.writeheader()
+            w.writerows(rows)
+        print(f"wrote {out_csv} ({len(rows)} rows)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,8 @@ Subpackages (lazily imported):
   solver     lanczos, MST, LAP                                 (ref: raft/solver, sparse/solver)
   spectral   spectral clustering/partitioning                  (ref: raft/spectral)
   label      label utilities                                   (ref: raft/label)
+  spatial    legacy spatial::knn aliases + haversine           (ref: raft/spatial)
+  config     global output-type conversion                     (ref: pylibraft.config)
   ops        Pallas TPU kernels backing the hot paths
   parallel   distributed (sharded) algorithm drivers           (ref: raft::comms consumers)
 """
@@ -47,6 +49,8 @@ _SUBMODULES = {
     "ops",
     "parallel",
     "utils",
+    "spatial",
+    "config",
 }
 
 

@@ -28,7 +28,18 @@ from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile, pairwise_distance
 from ..random.rng import as_key
 
-__all__ = ["KMeansParams", "KMeansOutput", "fit", "predict", "fit_predict", "transform", "cluster_cost", "find_k"]
+__all__ = [
+    "KMeansParams",
+    "KMeansOutput",
+    "fit",
+    "predict",
+    "fit_predict",
+    "transform",
+    "cluster_cost",
+    "find_k",
+    "init_plus_plus",
+    "update_centroids",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -83,9 +94,11 @@ def _lloyd(x, init_centroids, weights, k: int, max_iter: int, tol: float, tile: 
         centroids, _, it = state
         _, labels = _assign(x, centroids, tile)
         sums, counts = _update(x, labels, weights, k)
-        new_centroids = jnp.where(
-            counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centroids
-        )
+        # divisor must be the true (possibly fractional) weight total — a
+        # max(counts, 1) clamp would shrink centroids whenever a cluster's
+        # weight sum is < 1
+        denom = jnp.where(counts > 0, counts, 1.0)
+        new_centroids = jnp.where(counts[:, None] > 0, sums / denom[:, None], centroids)
         shift2 = jnp.sum(jnp.square(new_centroids - centroids))
         return new_centroids, shift2, it + 1
 
@@ -187,6 +200,35 @@ def cluster_cost(x, centroids, res: Resources | None = None):
     raft_runtime/cluster/kmeans.hpp cluster_cost)."""
     _, inertia = predict(x, centroids, res=res)
     return inertia
+
+
+def init_plus_plus(x, n_clusters: int, seed: int = 0, res: Resources | None = None):
+    """Standalone k-means++ seeding (reference:
+    raft_runtime/cluster/kmeans.hpp init_plus_plus; pylibraft
+    cluster.kmeans.init_plus_plus). Returns (n_clusters, d) centroids."""
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    expects(x.ndim == 2, "X must be (n_samples, n_features)")
+    expects(n_clusters <= x.shape[0], "n_clusters > n_samples")
+    tile = _choose_tile(x.shape[0], n_clusters, 1, res.workspace_bytes)
+    return _kmeans_plus_plus(x, as_key(seed), int(n_clusters), tile)
+
+
+def update_centroids(x, centroids, sample_weights=None, res: Resources | None = None):
+    """One weighted Lloyd update step (reference:
+    raft_runtime/cluster/kmeans.hpp update_centroids; pylibraft
+    cluster.kmeans.compute_new_centroids). Returns (new_centroids, labels)."""
+    res = res or default_resources()
+    x = jnp.asarray(x)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    k = centroids.shape[0]
+    tile = _choose_tile(x.shape[0], k, 1, res.workspace_bytes)
+    w = None if sample_weights is None else jnp.asarray(sample_weights, jnp.float32)
+    _, labels = _assign(x, centroids, tile)
+    sums, counts = _update(x, labels, w, k)
+    denom = jnp.where(counts > 0, counts, 1.0)
+    new_centroids = jnp.where(counts[:, None] > 0, sums / denom[:, None], centroids)
+    return new_centroids, labels
 
 
 def find_k(x, k_range, params: KMeansParams | None = None, res: Resources | None = None):

@@ -1,0 +1,66 @@
+"""Global output-type configuration.
+
+Re-design of pylibraft.config (python/pylibraft/pylibraft/config.py:15-46):
+``set_output_as`` installs a global conversion applied by
+``@auto_convert_output`` on the array-returning top-level entry points
+(pairwise_distance, brute-force knn, select_k, IVF/CAGRA search, kmeans
+predict/transform — the surface pylibraft converts). Index objects and
+dataclass outputs stay JAX pytrees. Supported targets: ``"jax"`` (default,
+no conversion), ``"numpy"``, ``"torch"`` (CPU tensors via dlpack when torch
+is importable), or any callable ``jax.Array -> Any``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["set_output_as", "get_output_as", "auto_convert_output"]
+
+_output_as: str | Callable = "jax"
+
+
+def set_output_as(output: str | Callable) -> None:
+    """Set the global output conversion (ref: pylibraft config.set_output_as,
+    config.py:20-46 — there 'cupy'/'torch'/callable; here 'jax'/'numpy'/
+    'torch'/callable)."""
+    global _output_as
+    if not (output in ("jax", "numpy", "torch") or callable(output)):
+        raise ValueError("output_as must be 'jax', 'numpy', 'torch', or a callable")
+    _output_as = output
+
+
+def get_output_as() -> str | Callable:
+    return _output_as
+
+
+def _convert(value: Any) -> Any:
+    if _output_as == "jax":
+        return value
+    if isinstance(value, jax.Array):
+        if callable(_output_as):
+            return _output_as(value)
+        if _output_as == "numpy":
+            import numpy as np
+
+            return np.asarray(value)
+        if _output_as == "torch":
+            import torch
+
+            return torch.from_dlpack(value)
+    if isinstance(value, tuple):
+        return tuple(_convert(v) for v in value)
+    return value
+
+
+def auto_convert_output(fn: Callable) -> Callable:
+    """Decorator applying the global conversion to the return value (ref:
+    pylibraft config auto_convert_output)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return _convert(fn(*args, **kwargs))
+
+    return wrapper

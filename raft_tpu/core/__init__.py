@@ -8,8 +8,10 @@ model rather than a bespoke cancellation token.
 """
 
 from .errors import RaftError, expects, fail
+from .interruptible import InterruptedException, cancel, interruptible, synchronize
 from .logger import logger, set_level
 from .resources import DeviceResources, Resources, default_resources, set_default_resources
+from .temporary_buffer import temporary_device_buffer
 from .serialize import (
     deserialize_json,
     deserialize_mdspan,
@@ -37,4 +39,9 @@ __all__ = [
     "serialize_json",
     "deserialize_json",
     "tracing",
+    "InterruptedException",
+    "interruptible",
+    "synchronize",
+    "cancel",
+    "temporary_device_buffer",
 ]

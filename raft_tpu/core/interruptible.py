@@ -1,0 +1,118 @@
+"""Cooperative cancellation — the reference's raft::interruptible.
+
+Re-design of cpp/include/raft/core/interruptible.hpp:71 (a per-thread token
+whose ``synchronize`` turns stream waits into cancellation points, with
+``cancel`` flippable from any thread) and its Python binding
+(pylibraft/common/interruptible.pyx, ``cuda_interruptible`` context manager +
+SIGINT hook). On TPU, XLA owns execution, so the cancellation points are the
+host-side blocking waits: :func:`synchronize` checks the token, blocks until
+the arrays are ready, and checks again — a long-running loop that calls it
+between jitted steps aborts promptly when another thread calls
+:func:`cancel`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+import jax
+
+__all__ = ["InterruptedException", "Token", "get_token", "synchronize", "yield_no_throw",
+           "cancel", "interruptible"]
+
+
+class InterruptedException(RuntimeError):
+    """Raised at a cancellation point (ref: raft::interruptible::interrupted_exception)."""
+
+
+class Token:
+    """Per-thread cancellation token (ref: interruptible.hpp:71 — shared
+    between the worker, which polls, and any controller, which cancels)."""
+
+    def __init__(self) -> None:
+        self._cancelled = threading.Event()
+
+    def cancel(self) -> None:
+        """Flip the flag (ref: interruptible::cancel — safe from any thread)."""
+        self._cancelled.set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def check(self) -> None:
+        """Cancellation point: raise and clear if cancelled (ref:
+        interruptible::yield — the flag resets on throw so the thread is
+        reusable afterwards)."""
+        if self._cancelled.is_set():
+            self._cancelled.clear()
+            raise InterruptedException("raft_tpu task cancelled")
+
+
+_tokens: dict[int, Token] = {}
+_tokens_lock = threading.Lock()
+
+
+def get_token(thread_id: int | None = None) -> Token:
+    """The token of the given (default: current) thread — ref:
+    interruptible::get_token(). Entries of dead threads are purged on access
+    (the reference GCs its store via weak pointers, interruptible.hpp) so a
+    recycled thread ident can never observe a stale cancelled token."""
+    tid = threading.get_ident() if thread_id is None else thread_id
+    with _tokens_lock:
+        live = {t.ident for t in threading.enumerate()}
+        live.add(tid)  # allow pre-registering a not-yet-seen controller target
+        for dead in [t for t in _tokens if t not in live]:
+            del _tokens[dead]
+        tok = _tokens.get(tid)
+        if tok is None:
+            tok = _tokens[tid] = Token()
+        return tok
+
+
+def cancel(thread_id: int | None = None) -> None:
+    """Cancel the given (default: current) thread's token."""
+    get_token(thread_id).cancel()
+
+
+def synchronize(*arrays) -> None:
+    """Cancellable device wait (ref: interruptible::synchronize:83 — the
+    stream sync that doubles as a cancellation point)."""
+    tok = get_token()
+    tok.check()
+    if arrays:
+        jax.block_until_ready(arrays)
+    tok.check()
+
+
+def yield_no_throw() -> bool:
+    """Non-throwing poll (ref: interruptible::yield_no_throw). Returns True
+    if the token was cancelled (and clears it)."""
+    tok = get_token()
+    if tok.cancelled():
+        tok._cancelled.clear()
+        return True
+    return False
+
+
+@contextlib.contextmanager
+def interruptible():
+    """Context manager hooking SIGINT to this thread's token — the analogue
+    of pylibraft's ``cuda_interruptible`` + ``synchronize`` pairing: Ctrl-C
+    inside the block cancels at the next synchronize() instead of tearing
+    down the process mid-execution. Only usable from the main thread (signal
+    semantics); elsewhere it degrades to a plain token scope."""
+    tok = get_token()
+    is_main = threading.current_thread() is threading.main_thread()
+    prev = None
+    if is_main:
+        def handler(signum, frame):
+            tok.cancel()
+
+        prev = signal.signal(signal.SIGINT, handler)
+    try:
+        yield tok
+    finally:
+        if is_main and prev is not None:
+            signal.signal(signal.SIGINT, prev)

@@ -1,0 +1,43 @@
+"""Host→device staging helper.
+
+Re-design of raft::make_temporary_device_buffer
+(cpp/include/raft/core/temporary_device_buffer.hpp) — a scoped view that
+stages host data on device and, for writable buffers, copies back on
+release. With unified jax.Array semantics this is a thin context manager:
+device placement on entry, optional host write-back on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+__all__ = ["temporary_device_buffer"]
+
+
+@contextlib.contextmanager
+def temporary_device_buffer(host_array, writeback: bool = False, device=None):
+    """Yield a device-resident jax.Array for ``host_array``; when
+    ``writeback`` is True and the caller replaced the staged array via
+    ``buf.array = ...``, the final value is copied back into ``host_array``
+    (which must be a writable numpy array)."""
+
+    class _Buf:
+        def __init__(self, arr):
+            self.array = arr
+
+    staged = jax.device_put(jnp_like(host_array), device)
+    buf = _Buf(staged)
+    try:
+        yield buf
+    finally:
+        if writeback:
+            np.copyto(host_array, np.asarray(buf.array))
+
+
+def jnp_like(x):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)

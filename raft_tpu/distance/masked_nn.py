@@ -6,9 +6,10 @@ The reference computes, per row of ``x``, the 1-NN over ``y`` restricted by a
 boolean adjacency matrix: ``y`` rows are partitioned into groups (given as
 exclusive prefix ends ``group_idxs``) and ``adj[i, g]`` says whether x_i may
 match group g. On the GPU this is a tiled fused kernel that skips fully-masked
-tiles; on TPU the distance matrix is one MXU GEMM and the mask is a fused
+tiles; on TPU the distance tile is one MXU GEMM and the mask is a fused
 select in the epilogue — XLA's fusion makes the skip a bandwidth question, and
-the masked argmin is a single f32 row reduction.
+the masked argmin is a single f32 row reduction. ``x`` rows are tiled under
+lax.map so the (tile, n) score block respects the workspace budget.
 """
 
 from __future__ import annotations
@@ -17,44 +18,58 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from .pairwise import _choose_tile
 
 __all__ = ["masked_l2_nn"]
 
 _f32 = jnp.float32
 
 
-@functools.partial(jax.jit, static_argnames=("sqrt",))
-def _masked_nn(x, y, adj, group_ends, sqrt: bool):
-    xf = x.astype(_f32)
-    yf = y.astype(_f32)
-    d2 = (
-        jnp.sum(xf * xf, axis=1)[:, None]
-        + jnp.sum(yf * yf, axis=1)[None, :]
-        - 2.0
-        * lax.dot_general(
-            xf, yf, (((1,), (1,)), ((), ())), precision=lax.Precision.HIGHEST,
-            preferred_element_type=_f32,
-        )
-    )
-    d2 = jnp.maximum(d2, 0.0)
-    if sqrt:
-        d2 = jnp.sqrt(d2)
-    # column j belongs to group g(j) = searchsorted(group_ends, j, 'right')
+@functools.partial(jax.jit, static_argnames=("sqrt", "tile"))
+def _masked_nn(x, y, adj, group_ends, sqrt: bool, tile: int):
+    m, d = x.shape
     n = y.shape[0]
+    yf = y.astype(_f32)
+    yn = jnp.sum(yf * yf, axis=1)
+    # column j belongs to group g(j) = searchsorted(group_ends, j, 'right')
     col_group = jnp.searchsorted(group_ends, jnp.arange(n), side="right")
-    col_mask = adj[:, col_group]
-    masked = jnp.where(col_mask, d2, jnp.inf)
-    idx = jnp.argmin(masked, axis=1)
-    val = jnp.take_along_axis(masked, idx[:, None], axis=1)[:, 0]
-    # rows with no admissible group keep idx = -1 (ref initializes to maxVal/-1)
-    any_valid = jnp.any(col_mask, axis=1)
-    return jnp.where(any_valid, val, jnp.inf), jnp.where(any_valid, idx, -1)
+
+    num = -(-m // tile)
+    pad = num * tile - m
+    xp = jnp.pad(x.astype(_f32), ((0, pad), (0, 0))) if pad else x.astype(_f32)
+    ap = jnp.pad(adj, ((0, pad), (0, 0))) if pad else adj
+
+    def per_tile(args):
+        xb, ab = args  # (tile, d), (tile, G)
+        d2 = (
+            jnp.sum(xb * xb, axis=1)[:, None]
+            + yn[None, :]
+            - 2.0
+            * lax.dot_general(
+                xb, yf, (((1,), (1,)), ((), ())), precision=lax.Precision.HIGHEST,
+                preferred_element_type=_f32,
+            )
+        )
+        d2 = jnp.maximum(d2, 0.0)
+        if sqrt:
+            d2 = jnp.sqrt(d2)
+        col_mask = ab[:, col_group]
+        masked = jnp.where(col_mask, d2, jnp.inf)
+        idx = jnp.argmin(masked, axis=1)
+        val = jnp.take_along_axis(masked, idx[:, None], axis=1)[:, 0]
+        any_valid = jnp.any(col_mask, axis=1)
+        return jnp.where(any_valid, val, jnp.inf), jnp.where(any_valid, idx, -1)
+
+    vals, idxs = lax.map(per_tile, (xp.reshape(num, tile, d), ap.reshape(num, tile, -1)))
+    return vals.reshape(num * tile)[:m], idxs.reshape(num * tile)[:m]
 
 
-def masked_l2_nn(x, y, adj, group_idxs, sqrt: bool = False):
+def masked_l2_nn(x, y, adj, group_idxs, sqrt: bool = False, res: Resources | None = None):
     """Masked L2 1-nearest-neighbor of each ``x`` row over admissible ``y`` groups.
 
     Reference: raft::distance::masked_l2_nn (masked_nn.cuh:109-150).
@@ -64,16 +79,25 @@ def masked_l2_nn(x, y, adj, group_idxs, sqrt: bool = False):
     x : (m, d) array. y : (n, d) array.
     adj : (m, num_groups) boolean — whether x_i may match group g.
     group_idxs : (num_groups,) int — *exclusive* end offset of each group in y
-        (monotone, last == n), as in the reference.
+        (strictly increasing, last == n), as in the reference.
     sqrt : report sqrt distances.
 
     Returns ``(distances (m,), indices (m,))`` — index −1 and distance +inf
     where every group is masked out.
     """
+    res = res or default_resources()
     x = jnp.asarray(x)
     y = jnp.asarray(y)
     adj = jnp.asarray(adj, bool)
-    group_idxs = jnp.asarray(group_idxs, jnp.int32)
+    group_host = np.asarray(group_idxs, np.int64)
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1], "bad x/y shapes")
-    expects(adj.shape == (x.shape[0], group_idxs.shape[0]), "adj must be (m, num_groups)")
-    return _masked_nn(x, y, adj, group_idxs, bool(sqrt))
+    expects(adj.shape == (x.shape[0], group_host.shape[0]), "adj must be (m, num_groups)")
+    expects(
+        group_host.size > 0
+        and int(group_host[-1]) == y.shape[0]
+        and bool(np.all(np.diff(group_host) > 0))
+        and int(group_host[0]) > 0,
+        "group_idxs must be strictly increasing exclusive ends with last == n",
+    )
+    tile = _choose_tile(x.shape[0], y.shape[0], 1, res.workspace_bytes)
+    return _masked_nn(x, y, adj, jnp.asarray(group_host, jnp.int32), bool(sqrt), tile)

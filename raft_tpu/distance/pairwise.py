@@ -23,6 +23,8 @@ ride the MXU at full rate with f32 accumulation via preferred_element_type).
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import functools
 import math
 
@@ -264,6 +266,7 @@ def _pairwise(x, y, metric: DistanceType, metric_arg: float, tile: int):
     return _tiled_rows(x, y, ew, tile)
 
 
+@auto_convert_output
 def pairwise_distance(x, y=None, metric="euclidean", metric_arg: float = 2.0, res: Resources | None = None):
     """Compute all-pairs distances between the rows of ``x`` and ``y``.
 

@@ -12,6 +12,8 @@ is carried by gathering with the top-k permutation.
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import functools
 
 import jax
@@ -34,6 +36,7 @@ def _select_k(values, in_idx, k: int, select_min: bool):
     return top_v, top_i.astype(jnp.int32)
 
 
+@auto_convert_output
 def select_k(values, k: int, select_min: bool = True, indices=None):
     """Select the k smallest (or largest) entries per row, with their indices.
 

@@ -102,6 +102,8 @@ def build(dataset, metric="sqeuclidean", n_landmarks: int | None = None,
         "ball_cover supports L2 / haversine metrics, got %s",
         mt.name,
     )
+    if mt == DistanceType.Haversine:
+        expects(d == 2, "haversine requires (lat, lon) inputs with d == 2")
     L = n_landmarks or max(int(math.isqrt(n)), 1)
     expects(L <= n, "n_landmarks > n_samples")
 

@@ -12,6 +12,8 @@ the reference runs after stream-pool multi-probe (knn_brute_force.cuh:490).
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import functools
 
 import jax
@@ -27,8 +29,11 @@ from ..matrix.select_k import select_k
 __all__ = ["knn", "knn_merge_parts", "BruteForce"]
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile", "inner_tile"))
-def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, tile: int, inner_tile: int, keep_mask=None):
+@functools.partial(
+    jax.jit, static_argnames=("k", "metric", "metric_arg", "tile", "inner_tile", "approx")
+)
+def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float,
+            tile: int, inner_tile: int, keep_mask=None, approx: bool = False):
     m = queries.shape[0]
     n = dataset.shape[0]
     # kNN ordering is identical under expanded vs unexpanded L2, so route the
@@ -46,6 +51,15 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, t
         if keep_mask is not None:
             # fused predicate filter (ref: neighbors/sample_filter_types.hpp)
             d = jnp.where(keep_mask[None, :], d, jnp.inf if select_min else -jnp.inf)
+        if approx:
+            # TPU-native PartialReduce selection (lax.approx_*_k): ~2x faster
+            # than the exact sort-based TopK at >0.99 expected recall — the
+            # TPU counterpart of the reference's recall/QPS trade knobs
+            if select_min:
+                top_v, top_i = lax.approx_min_k(d, k, recall_target=0.99)
+            else:
+                top_v, top_i = lax.approx_max_k(d, k, recall_target=0.99)
+            return top_v, top_i.astype(jnp.int32)
         v = -d if select_min else d
         top_v, top_i = lax.top_k(v, k)
         return (-top_v if select_min else top_v), top_i.astype(jnp.int32)
@@ -60,13 +74,16 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float, t
     return dists, idx
 
 
+@auto_convert_output
 def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
-        sample_filter=None, res: Resources | None = None):
+        sample_filter=None, mode: str = "exact", res: Resources | None = None):
     """Exact kNN of ``queries`` in ``dataset`` (reference:
     brute_force::knn, neighbors/brute_force.cuh; pylibraft
     neighbors/brute_force.pyx knn). ``sample_filter`` is an optional
     :class:`~raft_tpu.neighbors.sample_filter.BitsetFilter` / boolean keep-mask
-    over dataset rows. Returns (distances (m, k), indices (m, k))."""
+    over dataset rows. ``mode``: "exact" (sort-based TopK) or "approx"
+    (TPU PartialReduce, ≥0.99 expected recall, ~2x faster).
+    Returns (distances (m, k), indices (m, k))."""
     from .sample_filter import resolve_filter
 
     res = res or default_resources()
@@ -76,6 +93,7 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     expects(dataset.shape[1] == queries.shape[1], "feature dims must match")
     n = dataset.shape[0]
     expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
+    expects(mode in ("exact", "approx"), "mode must be 'exact' or 'approx', got %r", mode)
     mt = resolve_metric(metric)
     keep_mask = resolve_filter(sample_filter)
     if keep_mask is not None:
@@ -84,7 +102,8 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     # elementwise-metric broadcast within _pairwise
     tile = _choose_tile(queries.shape[0], n, 1, res.workspace_bytes)
     inner_tile = _choose_tile(tile, n, dataset.shape[1], res.workspace_bytes)
-    return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile, keep_mask)
+    return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile,
+                   keep_mask, approx=mode == "approx")
 
 
 def knn_merge_parts(part_dists, part_ids, k: int | None = None, select_min: bool = True):

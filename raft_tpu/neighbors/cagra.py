@@ -25,6 +25,8 @@ TPU re-think makes it batch-synchronous:
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import dataclasses
 import functools
 
@@ -331,6 +333,7 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
     return out_d, beam_ids[:, :k]
 
 
+@auto_convert_output
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
     cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD)."""

@@ -5,41 +5,54 @@ Re-design of raft::neighbors::epsilon_neighborhood::eps_neighbors_l2sq
 spatial/knn/detail/epsilon_neighborhood.cuh). The reference fuses a tiled
 L2² computation with the ≤ eps compare and a per-row popcount (vertex
 degree). On TPU the distance tile is an MXU GEMM and the compare + degree
-reduction fuse into its epilogue.
+reduction fuse into its epilogue; x rows are tiled under lax.map so only the
+boolean output — never the f32 distance matrix — exists at full (m, n) size.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..core.errors import expects
+from ..core.resources import Resources, default_resources
+from ..distance.pairwise import _choose_tile
 
 __all__ = ["eps_neighbors_l2sq"]
 
 _f32 = jnp.float32
 
 
-@jax.jit
-def _eps_nn(x, y, eps_sq):
-    xf = x.astype(_f32)
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _eps_nn(x, y, eps_sq, tile: int):
+    m, d = x.shape
     yf = y.astype(_f32)
-    d2 = (
-        jnp.sum(xf * xf, axis=1)[:, None]
-        + jnp.sum(yf * yf, axis=1)[None, :]
-        - 2.0
-        * lax.dot_general(
-            xf, yf, (((1,), (1,)), ((), ())), precision=lax.Precision.HIGHEST,
-            preferred_element_type=_f32,
+    yn = jnp.sum(yf * yf, axis=1)
+    num = -(-m // tile)
+    pad = num * tile - m
+    xp = jnp.pad(x.astype(_f32), ((0, pad), (0, 0))) if pad else x.astype(_f32)
+
+    def per_tile(xb):
+        d2 = (
+            jnp.sum(xb * xb, axis=1)[:, None]
+            + yn[None, :]
+            - 2.0
+            * lax.dot_general(
+                xb, yf, (((1,), (1,)), ((), ())), precision=lax.Precision.HIGHEST,
+                preferred_element_type=_f32,
+            )
         )
-    )
-    adj = jnp.maximum(d2, 0.0) <= eps_sq
-    deg = jnp.sum(adj, axis=1, dtype=jnp.int32)
-    return adj, deg
+        adj = jnp.maximum(d2, 0.0) <= eps_sq
+        return adj, jnp.sum(adj, axis=1, dtype=jnp.int32)
+
+    adj, deg = lax.map(per_tile, xp.reshape(num, tile, d))
+    return adj.reshape(num * tile, -1)[:m], deg.reshape(num * tile)[:m]
 
 
-def eps_neighbors_l2sq(x, y=None, eps: float = 1.0):
+def eps_neighbors_l2sq(x, y=None, eps: float = 1.0, res: Resources | None = None):
     """Boolean adjacency of all (x_i, y_j) pairs with ‖x_i − y_j‖² ≤ eps.
 
     Reference: eps_neighbors_l2sq (neighbors/epsilon_neighborhood.cuh:78-105).
@@ -47,9 +60,11 @@ def eps_neighbors_l2sq(x, y=None, eps: float = 1.0):
     ``(adj (m, n) bool, vertex_degree (m+1,) int32)`` where the final entry of
     ``vertex_degree`` is the total edge count (the reference's ``vd + m``).
     """
+    res = res or default_resources()
     x = jnp.asarray(x)
     y = x if y is None else jnp.asarray(y)
     expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1], "bad x/y shapes")
-    adj, deg = _eps_nn(x, y, _f32(eps))
+    tile = _choose_tile(x.shape[0], y.shape[0], 1, res.workspace_bytes)
+    adj, deg = _eps_nn(x, y, _f32(eps), tile)
     vd = jnp.concatenate([deg, jnp.sum(deg, keepdims=True)])
     return adj, vd

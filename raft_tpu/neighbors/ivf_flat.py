@@ -22,6 +22,8 @@ list groups :86,135-153; search detail/ivf_flat_search-inl.cuh:130 — coarse GE
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import dataclasses
 import functools
 
@@ -281,6 +283,7 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
     return dists, idx
 
 
+@auto_convert_output
 def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
            sample_filter=None, res: Resources | None = None):
     """Search the index (reference: ivf_flat::search, ivf_flat-inl.cuh;

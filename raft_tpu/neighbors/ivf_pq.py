@@ -25,6 +25,8 @@ ivf_pq_types.hpp:48-140). The TPU re-think:
 
 from __future__ import annotations
 
+from ..config import auto_convert_output
+
 import dataclasses
 import functools
 
@@ -460,6 +462,7 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
     return dists, idx
 
 
+@auto_convert_output
 def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
            sample_filter=None, res: Resources | None = None):
     """Search (reference: ivf_pq::search :723; pylibraft neighbors/ivf_pq;

@@ -1,0 +1,28 @@
+"""raft_tpu.runtime — native host runtime (C++ via ctypes).
+
+Reference analogue: the precompiled runtime layer (cpp/src + raft_runtime
+headers, SURVEY.md §2.7) and the bench harness's C++ dataset IO
+(cpp/bench/ann/src/common/dataset.h). See cpp/runtime.cpp.
+"""
+
+from .native import (
+    available,
+    bin_info,
+    load_bin,
+    merge_parts_host,
+    read_bin_chunk,
+    refine_host,
+    write_bin,
+    BinDataset,
+)
+
+__all__ = [
+    "available",
+    "bin_info",
+    "load_bin",
+    "read_bin_chunk",
+    "write_bin",
+    "refine_host",
+    "merge_parts_host",
+    "BinDataset",
+]

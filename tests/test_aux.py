@@ -1,0 +1,143 @@
+"""Aux-subsystem tests: interruptible, output config, temporary buffer,
+kmeans runtime-surface parity.
+
+Analogues of pylibraft's test_z_interruptible.py, config-driven output tests,
+and the raft_runtime kmeans entry points (raft_runtime/cluster/kmeans.hpp).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import raft_tpu.config as config
+from raft_tpu.cluster import kmeans
+from raft_tpu.core import InterruptedException, interruptible, synchronize, temporary_device_buffer
+from raft_tpu.core.interruptible import cancel, get_token, yield_no_throw
+from raft_tpu.random import make_blobs
+
+
+def test_interruptible_cancel_same_thread():
+    cancel()  # cancel own token
+    with pytest.raises(InterruptedException):
+        synchronize()
+    # flag cleared on throw — next sync passes
+    synchronize()
+
+
+def test_interruptible_cancel_cross_thread():
+    state = {}
+
+    def worker():
+        tok = get_token()
+        state["tid"] = threading.get_ident()
+        state["ready"].set()
+        state["go"].wait()
+        try:
+            for _ in range(1000):
+                synchronize()
+                import time
+
+                time.sleep(0.001)
+            state["result"] = "completed"
+        except InterruptedException:
+            state["result"] = "cancelled"
+
+    state["ready"] = threading.Event()
+    state["go"] = threading.Event()
+    t = threading.Thread(target=worker)
+    t.start()
+    state["ready"].wait()
+    cancel(state["tid"])  # cancel from the controller thread
+    state["go"].set()
+    t.join()
+    assert state["result"] == "cancelled"
+
+
+def test_yield_no_throw():
+    cancel()
+    assert yield_no_throw() is True
+    assert yield_no_throw() is False
+
+
+def test_interruptible_context():
+    with interruptible() as tok:
+        assert not tok.cancelled()
+
+
+def test_config_output_as(rng):
+    from raft_tpu.config import auto_convert_output
+
+    @auto_convert_output
+    def produce():
+        import jax.numpy as jnp
+
+        return jnp.ones((3, 3)), jnp.zeros((2,))
+
+    try:
+        config.set_output_as("numpy")
+        a, b = produce()
+        assert isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+        config.set_output_as(lambda arr: ("converted", np.asarray(arr).shape))
+        a, _ = produce()
+        assert a == ("converted", (3, 3))
+        with pytest.raises(ValueError):
+            config.set_output_as("cupy")
+    finally:
+        config.set_output_as("jax")
+
+
+def test_config_wired_into_public_api(rng):
+    from raft_tpu.neighbors import knn
+
+    x = rng.random((50, 4)).astype(np.float32)
+    try:
+        config.set_output_as("numpy")
+        d, i = knn(x, x[:5], 3)
+        assert isinstance(d, np.ndarray) and isinstance(i, np.ndarray)
+    finally:
+        config.set_output_as("jax")
+    import jax
+
+    d, _ = knn(x, x[:5], 3)
+    assert isinstance(d, jax.Array)
+
+
+def test_weighted_update_centroids_fractional_weights(rng):
+    # regression: divisor must be the true weight total, not max(total, 1)
+    x = np.array([[0.0, 0.0], [1.0, 1.0], [10.0, 10.0]], np.float32)
+    c0 = np.array([[0.4, 0.4], [10.0, 10.0]], np.float32)
+    w = np.full(3, 0.01, np.float32)
+    c1, _ = kmeans.update_centroids(x, c0, sample_weights=w)
+    np.testing.assert_allclose(np.asarray(c1)[0], [0.5, 0.5], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c1)[1], [10.0, 10.0], atol=1e-5)
+
+
+def test_temporary_device_buffer():
+    host = np.arange(12, dtype=np.float32).reshape(3, 4)
+    with temporary_device_buffer(host, writeback=True) as buf:
+        buf.array = buf.array * 2
+    np.testing.assert_allclose(host, np.arange(12, dtype=np.float32).reshape(3, 4) * 2)
+
+
+def test_kmeans_init_plus_plus_and_update(rng):
+    x, labels_true = make_blobs(n_samples=300, n_features=5, n_clusters=4, seed=0)
+    x = np.asarray(x)
+    c0 = kmeans.init_plus_plus(x, 4, seed=1)
+    assert np.asarray(c0).shape == (4, 5)
+    # ++ seeds are spread out: no two identical centers
+    c0n = np.asarray(c0)
+    assert np.unique(c0n, axis=0).shape[0] == 4
+
+    c1, labels = kmeans.update_centroids(x, c0)
+    assert np.asarray(c1).shape == (4, 5)
+    # one Lloyd step must not increase cost
+    cost0 = float(kmeans.cluster_cost(x, c0))
+    cost1 = float(kmeans.cluster_cost(x, c1))
+    assert cost1 <= cost0 + 1e-5
+
+
+def test_kmeans_find_k(rng):
+    x, _ = make_blobs(n_samples=400, n_features=4, n_clusters=3, cluster_std=0.3, seed=2)
+    best_k, scores = kmeans.find_k(np.asarray(x), range(2, 6))
+    assert best_k == 3, scores

@@ -1,0 +1,54 @@
+"""ANN bench harness smoke test (bench/ann/run.py).
+
+Analogue of the reference harness's CI smoke coverage: a tiny synthetic
+config must build, search, compute recall, and emit the CSV.
+"""
+
+import csv
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_harness_end_to_end(tmp_path):
+    conf_dir = tmp_path / "conf"
+    conf_dir.mkdir()
+    conf = {
+        "dataset": {
+            "name": "tiny",
+            "synthetic": {"n": 2000, "dim": 16, "n_queries": 100, "seed": 0},
+            "distance": "euclidean",
+        },
+        "search_basic_param": {"batch_size": 100, "k": 5, "run_count": 1},
+        "index": [
+            {"name": "bf", "algo": "raft_tpu.brute_force", "build_param": {},
+             "search_params": [{}]},
+            {"name": "ivf", "algo": "raft_tpu.ivf_flat",
+             "build_param": {"n_lists": 8},
+             "search_params": [{"n_probes": 8}]},
+        ],
+    }
+    (conf_dir / "tiny.json").write_text(json.dumps(conf))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench/ann/run.py"),
+         "--conf", str(conf_dir / "tiny.json"), "--build", "--search"],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    out_csv = tmp_path / "results" / "tiny.csv"
+    assert out_csv.exists(), proc.stdout
+    rows = list(csv.DictReader(open(out_csv)))
+    assert len(rows) == 2
+    by_name = {r["name"]: r for r in rows}
+    # brute force IS the ground truth → recall 1.0
+    assert float(by_name["bf"]["recall@5"]) == 1.0
+    # probing all 8 lists is exhaustive → recall 1.0
+    assert float(by_name["ivf"]["recall@5"]) > 0.99
+    assert float(by_name["bf"]["qps"]) > 0

@@ -125,3 +125,22 @@ class TestRefine:
         dists, _ = refine(x, q, cand, k=4, metric="euclidean")
         wd, _ = _exact(x, q, 4, "euclidean")
         np.testing.assert_allclose(np.asarray(dists), wd, atol=1e-3, rtol=1e-4)
+
+
+def test_knn_approx_mode(rng):
+    """mode='approx' (TPU PartialReduce fast path) keeps high recall; on the
+    CPU backend lax.approx_min_k reduces exactly for these sizes."""
+    from raft_tpu.neighbors import knn
+
+    x = rng.random((2000, 24)).astype(np.float32)
+    q = rng.random((50, 24)).astype(np.float32)
+    d_a, i_a = knn(x, q, 10, mode="approx")
+    d_e, i_e = knn(x, q, 10, mode="exact")
+    recall = np.mean([
+        len(set(np.asarray(i_a)[i]) & set(np.asarray(i_e)[i])) / 10 for i in range(50)
+    ])
+    assert recall > 0.95
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        knn(x, q, 10, mode="bogus")
