@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""raft_tpu primitive micro-benchmarks.
+
+Counterpart of the reference's google-benchmark prim suite
+(cpp/bench/prims/{distance,matrix,cluster,neighbors}/ — e.g.
+distance/distance_exp_l2.cu, matrix/select_k.cu, cluster/kmeans.cu). Each
+case reports wall ms and achieved GB/s or GFLOP/s.
+
+Timing protocol (see docs/ann_benchmarks.md "Measurement honesty"): every
+iteration gets distinct input slices, iterations are chained inside one XLA
+program via lax.map, and the output is materialized to host — immune to
+device tunnels that no-op block_until_ready.
+
+Usage: python bench/prims/run.py [--filter substr] [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def measure(make_fn, batches, iters: int):
+    """make_fn() -> jitted fn over stacked batches; returns s/iter."""
+    import jax
+    import numpy as np
+
+    f = make_fn()
+    np.asarray(jax.tree_util.tree_leaves(f(batches[0]))[0])  # compile+warm
+    best = float("inf")
+    for b in batches[1:]:
+        t0 = time.perf_counter()
+        np.asarray(jax.tree_util.tree_leaves(f(b))[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / iters
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--filter", default="")
+    ap.add_argument("--iters", type=int, default=4, help="chained iterations per timing call")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    rng = np.random.default_rng(0)
+    iters = args.iters
+    rows = []
+
+    def bench(name, make_fn, batches, work, unit):
+        if args.filter and args.filter not in name:
+            return
+        sec = measure(make_fn, batches, iters)
+        rate = work / sec / 1e9
+        rows.append((name, sec * 1e3, rate, unit))
+        print(f"{name:42s} {sec*1e3:9.2f} ms   {rate:9.1f} {unit}")
+
+    # ---- pairwise distance (ref: distance_exp_l2.cu) ----
+    m, n, d = 4096, 4096, 128
+    for metric in ("sqeuclidean", "cosine", "l1"):
+        from raft_tpu.distance.pairwise import _pairwise
+        from raft_tpu.distance.types import resolve_metric
+
+        mt = resolve_metric(metric)
+        xs = [jnp.asarray(rng.random((iters, m, d), np.float32)) for _ in range(3)]
+        y = jnp.asarray(rng.random((n, d), np.float32))
+
+        def mk(mt=mt):
+            def one(x):
+                return jnp.sum(_pairwise(x, y, mt, 2.0, 1024))
+            return jax.jit(lambda xb: lax.map(one, xb))
+
+        bench(f"pairwise_distance/{metric} {m}x{n}x{d}", mk, xs,
+              iters * 2.0 * m * n * d, "GFLOP/s")
+
+    # ---- fused L2 1-NN (ref: distance/fused_l2_nn.cu) ----
+    from raft_tpu.distance.fused_nn import _fused_l2_nn
+
+    k_centers = 1024
+    c = jnp.asarray(rng.random((k_centers, d), np.float32))
+    xs = [jnp.asarray(rng.random((iters, m, d), np.float32)) for _ in range(3)]
+
+    def mk_fnn():
+        def one(x):
+            return _fused_l2_nn(x, c, False, 2048)[1]
+        return jax.jit(lambda xb: lax.map(one, xb))
+
+    bench(f"fused_l2_nn {m}x{k_centers}x{d}", mk_fnn, xs,
+          iters * 2.0 * m * k_centers * d, "GFLOP/s")
+
+    # ---- select_k (ref: matrix/select_k.cu) ----
+    from raft_tpu.matrix.select_k import _select_k
+
+    for nn_cols, kk in ((16384, 64), (65536, 10)):
+        xs = [jnp.asarray(rng.random((iters, 512, nn_cols), np.float32)) for _ in range(3)]
+
+        def mk_sel(kk=kk):
+            def one(x):
+                return _select_k(x, None, kk, True)
+            return jax.jit(lambda xb: lax.map(one, xb))
+
+        bench(f"select_k n={nn_cols} k={kk} rows=512", mk_sel, xs,
+              iters * 512 * nn_cols * 4, "GB/s")
+
+    # ---- kmeans one Lloyd step (ref: cluster/kmeans.cu) ----
+    from raft_tpu.cluster.kmeans import _assign, _update
+
+    kc = 256
+    xs = [jnp.asarray(rng.random((iters, 65536, 64), np.float32)) for _ in range(3)]
+    c0 = jnp.asarray(rng.random((kc, 64), np.float32))
+
+    def mk_km():
+        def one(x):
+            _, labels = _assign(x, c0, 8192)
+            sums, counts = _update(x, labels, None, kc)
+            return sums
+        return jax.jit(lambda xb: lax.map(one, xb))
+
+    bench(f"kmeans_lloyd_step 65536x64 k={kc}", mk_km, xs,
+          iters * 2.0 * 65536 * kc * 64 * 2, "GFLOP/s")
+
+    # ---- brute-force knn (ref: neighbors/knn.cuh) ----
+    from raft_tpu.neighbors.brute_force import _bf_knn
+    from raft_tpu.distance.types import DistanceType
+
+    ds = jnp.asarray(rng.random((100_000, 128), np.float32))
+    xs = [jnp.asarray(rng.random((iters, 2000, 128), np.float32)) for _ in range(3)]
+
+    def mk_knn():
+        def one(q):
+            return _bf_knn(ds, q, 10, DistanceType.L2Expanded, 2.0, 1000, 1000)[1]
+        return jax.jit(lambda xb: lax.map(one, xb))
+
+    bench("bf_knn 100k x 128, q=2000, k=10", mk_knn, xs,
+          iters * 2.0 * 2000 * 100_000 * 128, "GFLOP/s")
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,12 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         repl_idx = jax.random.categorical(kc, logits, shape=(k,))
         repl = xf[repl_idx]
         centers = jnp.where(small[:, None], repl, centers)
+
+        # Note: no hot-cluster splitting here — actively relocating centers
+        # each iteration proved unstable (center churn prevents Lloyd
+        # convergence and *grows* the max list). Skew is instead handled at
+        # the index layer: oversized lists split into capacity-bounded
+        # sub-lists sharing a center (neighbors/_list_utils.split_oversized).
         return centers, key
 
     centers, _ = lax.fori_loop(0, n_iters, body, (init_centers.astype(jnp.float32), key))

@@ -17,7 +17,7 @@ from jax.sharding import Mesh
 
 from .comms import Comms
 
-__all__ = ["initialize", "local_mesh"]
+__all__ = ["initialize", "local_mesh", "global_mesh"]
 
 
 def initialize(coordinator_address: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
@@ -28,6 +28,17 @@ def initialize(coordinator_address: str | None = None, num_processes: int | None
     no arguments JAX auto-discovers the topology from the TPU environment.
     """
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
+
+
+def global_mesh(axis_names: tuple[str, ...] = ("data",), shape: tuple[int, ...] | None = None) -> Mesh:
+    """Build a mesh over ALL processes' devices after :func:`initialize` —
+    the multi-host analogue of raft-dask's per-worker handle injection.
+    ``shape`` defaults to all devices on the first axis; heavy axes should map
+    to ICI (inner/fastest-varying dimensions)."""
+    devs = np.array(jax.devices())
+    if shape is None:
+        shape = (devs.size,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devs.reshape(shape), axis_names)
 
 
 def local_mesh(axis: str = "data", n_devices: int | None = None) -> Comms:

@@ -7,6 +7,7 @@ interruptibility maps to Python's native KeyboardInterrupt + XLA's execution
 model rather than a bespoke cancellation token.
 """
 
+from . import operators
 from .errors import RaftError, expects, fail
 from .interruptible import InterruptedException, cancel, interruptible, synchronize
 from .logger import logger, set_level
@@ -44,4 +45,5 @@ __all__ = [
     "synchronize",
     "cancel",
     "temporary_device_buffer",
+    "operators",
 ]

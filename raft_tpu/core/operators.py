@@ -1,0 +1,148 @@
+"""Composable operator vocabulary.
+
+Parity surface for the reference's host/device functors
+(cpp/include/raft/core/operators.hpp — identity/sq/abs/add/sub/mul/div/min/
+max/pow/argmin-style KVP ops and the compose/plug adapters, core/kvp.hpp
+KeyValuePair). Under JAX these are plain functions usable inside jit and as
+``map_reduce`` arguments; KeyValuePair survives as the (key, value) pair used
+by fused 1-NN reductions (distance/fused_nn.py returns exactly this shape).
+"""
+
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+__all__ = [
+    "identity_op", "void_op", "sq_op", "abs_op", "cast_op", "key_op", "value_op",
+    "add_op", "sub_op", "mul_op", "div_op", "div_checkzero_op", "pow_op",
+    "min_op", "max_op", "sqrt_op", "nz_op", "equal_op", "notequal_op",
+    "compose_op", "plug_const_op", "KeyValuePair", "argmin_op", "argmax_op",
+]
+
+
+class KeyValuePair(typing.NamedTuple):
+    """Reference: raft::KeyValuePair (core/kvp.hpp)."""
+
+    key: typing.Any
+    value: typing.Any
+
+
+def identity_op(x):
+    return x
+
+
+def void_op(*_args):
+    return None
+
+
+def sq_op(x):
+    return x * x
+
+
+def abs_op(x):
+    return jnp.abs(x)
+
+
+def sqrt_op(x):
+    return jnp.sqrt(x)
+
+
+def nz_op(x):
+    """1 where nonzero (ref: nz_op)."""
+    return jnp.where(x != 0, 1.0, 0.0)
+
+
+def cast_op(dtype):
+    """Reference: cast_op<T> — returns the casting functor."""
+
+    def f(x):
+        return jnp.asarray(x).astype(dtype)
+
+    return f
+
+
+def key_op(kvp: KeyValuePair):
+    return kvp.key
+
+
+def value_op(kvp: KeyValuePair):
+    return kvp.value
+
+
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    """a / b with 0 where b == 0 (ref: div_checkzero_op)."""
+    return jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b))
+
+
+def pow_op(a, b):
+    return jnp.power(a, b)
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def equal_op(a, b):
+    return a == b
+
+
+def notequal_op(a, b):
+    return a != b
+
+
+def argmin_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    """KVP reduction keeping the smaller value (ref: argmin_op, operators.hpp)."""
+    take_a = (a.value < b.value) | ((a.value == b.value) & (a.key <= b.key))
+    return KeyValuePair(
+        jnp.where(take_a, a.key, b.key), jnp.where(take_a, a.value, b.value)
+    )
+
+
+def argmax_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    take_a = (a.value > b.value) | ((a.value == b.value) & (a.key <= b.key))
+    return KeyValuePair(
+        jnp.where(take_a, a.key, b.key), jnp.where(take_a, a.value, b.value)
+    )
+
+
+def compose_op(*fns):
+    """Right-to-left composition (ref: compose_op — outer(inner(...)))."""
+
+    def f(x, *args):
+        for fn in reversed(fns[1:]):
+            x = fn(x, *args)
+            args = ()
+        return fns[0](x)
+
+    return f
+
+
+def plug_const_op(const, op):
+    """Bind a constant second operand (ref: plug_const_op)."""
+
+    def f(x):
+        return op(x, const)
+
+    return f

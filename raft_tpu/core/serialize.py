@@ -29,13 +29,30 @@ __all__ = [
 
 
 def serialize_mdspan(fp: BinaryIO, arr) -> None:
-    """Write an array as a .npy stream (reference: serialize_mdspan, core/serialize.hpp)."""
-    np.save(fp, np.asarray(jax.device_get(arr)), allow_pickle=False)
+    """Write an array as a 1-byte dtype marker + .npy stream (reference:
+    serialize_mdspan, core/serialize.hpp). bfloat16 — which numpy cannot
+    represent natively — travels as a uint16 bit-pattern npy block behind the
+    ``B`` marker; everything else is a plain npy block behind ``N``."""
+    host = np.asarray(jax.device_get(arr))
+    if host.dtype == np.dtype("V2") or str(arr.dtype) == "bfloat16":
+        fp.write(b"B")
+        np.save(fp, host.view(np.uint16), allow_pickle=False)
+    else:
+        fp.write(b"N")
+        np.save(fp, host, allow_pickle=False)
 
 
 def deserialize_mdspan(fp: BinaryIO, device=None):
-    """Read a .npy stream back; returns a host numpy array (caller device_puts)."""
+    """Read a marked .npy stream back; returns a host numpy array — bfloat16
+    blocks come back as a jax bfloat16-typed array (caller device_puts)."""
+    marker = fp.read(1)
+    if marker not in (b"N", b"B"):
+        raise ValueError(f"bad mdspan marker {marker!r}")
     host = np.load(fp, allow_pickle=False)
+    if marker == b"B":
+        import jax.numpy as jnp
+
+        host = host.view(jnp.bfloat16.dtype)
     return host if device is None else jax.device_put(host, device)
 
 

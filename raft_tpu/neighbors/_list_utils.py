@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from ..distance.fused_nn import _fused_l2_nn
 from ..distance.types import DistanceType
 
-__all__ = ["round_up", "list_positions", "plan_search_tiles", "assign_to_lists"]
+__all__ = ["round_up", "list_positions", "plan_search_tiles", "assign_to_lists",
+           "split_oversized", "bound_capacity"]
 
 
 def round_up(x: int, mult: int) -> int:
@@ -43,6 +44,53 @@ def list_positions(labels, n_lists: int):
     pos_sorted = jnp.arange(n, dtype=jnp.int32) - jnp.take(starts, sorted_labels).astype(jnp.int32)
     pos = jnp.zeros((n,), jnp.int32).at[order].set(pos_sorted)
     return pos, counts.astype(jnp.int32)
+
+
+def split_oversized(labels, n_lists: int, cap_target: int):
+    """Split lists larger than ``cap_target`` into sub-lists that share the
+    parent's center.
+
+    The padded layout prices every list at the MAX size, so one hot cluster
+    inflates all scans; bounding capacity by sub-list splitting is the
+    coarse-grained analogue of the reference's fixed 32-vector interleaved
+    groups (ivf_flat_build.cuh:135-153). Sub-lists duplicate their parent's
+    coarse center, so a query's coarse top-k naturally ranks them adjacently
+    (identical scores) and probes them together.
+
+    Returns ``(new_labels (n,), rep (n_lists,) host int array)`` where
+    ``rep[l]`` is how many sub-lists list ``l`` became (all 1 = no change);
+    the new list count is ``rep.sum()``. Callers repeat center-indexed arrays
+    with ``np.repeat(arr, rep, axis=0)``.
+    """
+    import numpy as np
+
+    pos, counts = list_positions(labels, n_lists)
+    counts_h = np.asarray(counts)
+    rep = np.maximum(1, -(-counts_h // cap_target)).astype(np.int64)
+    base = np.concatenate([[0], np.cumsum(rep)[:-1]]).astype(np.int32)
+    new_labels = jnp.asarray(base)[labels] + (pos // cap_target).astype(jnp.int32)
+    return new_labels, rep
+
+
+def bound_capacity(labels, n_lists: int):
+    """Shared capacity policy for IVF fills: lists larger than 2x the mean
+    split into sub-lists (see :func:`split_oversized`); otherwise capacity is
+    the max size rounded to the sublane tile.
+
+    Returns ``(labels, rep, n_lists, capacity)`` where ``rep`` is None when no
+    splitting happened, else the host repeat-count array for center-indexed
+    arrays (``np.repeat(arr, rep, axis=0)``).
+    """
+    import numpy as np
+
+    sizes = jnp.bincount(labels, length=n_lists)
+    max_size = max(int(jnp.max(sizes)), 1)
+    mean_size = max(labels.shape[0] / n_lists, 1.0)
+    cap_target = round_up(max(int(mean_size * 2.0), 8), 8)
+    if max_size <= cap_target:
+        return labels, None, n_lists, round_up(max_size, 8)
+    new_labels, rep = split_oversized(labels, n_lists, cap_target)
+    return new_labels, rep, int(rep.sum()), cap_target
 
 
 def plan_search_tiles(m: int, n_probes: int, k: int, capacity: int,

@@ -1,3 +1,7 @@
 """raft_tpu.ops — Pallas TPU kernels backing hot paths (select_k variants,
 IVF scan fusions). Population grows as profiling identifies XLA-composition
 bottlenecks; modules land here with benchmarks."""
+
+from .topk import TOPK_MAX_K, topk_pallas
+
+__all__ = ["topk_pallas", "TOPK_MAX_K"]

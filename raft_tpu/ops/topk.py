@@ -1,0 +1,134 @@
+"""Pallas streaming top-k with index payloads.
+
+The critical selection kernel called out in SURVEY.md §2.3 (P8): the
+reference implements two CUDA selectors (11-bit radix filter,
+matrix/detail/select_radix.cuh, and warp bitonic queues,
+detail/select_warpsort.cuh) because a full sort is wasteful for k ≪ n. XLA's
+TopK on TPU is sort-based; for the ANN stack's k ≤ ~64 a streaming selector
+wins: score columns arrive in VMEM blocks (Pallas pipelines the HBM reads),
+and a running sorted top-k per row lives in VMEM scratch. Each block is
+merged by k iterations of (min, argmin, mask) on the VPU — O(k·(k+B)) per
+block instead of a sort network over n.
+
+Exact (bit-identical values to lax.top_k for select_min; ties may resolve to
+a different but equally-minimal index).
+
+Measured on TPU v5 lite (100k cols, k=10): this kernel does NOT beat XLA —
+the k-iteration argmax/mask loop re-reads each block ~4k times on the VPU
+(66-138 ms/batch vs 56 ms for lax.top_k and 24 ms for lax.approx_min_k), so
+the library's hot paths keep lax.top_k (exact) / approx_min_k (fast). The
+kernel stays as the starting point for a future single-pass threshold-filter
+variant and as the reference Pallas selector for k > XLA's TopK sweet spot.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["topk_pallas", "TOPK_MAX_K"]
+
+TOPK_MAX_K = 128
+_NEG = -jnp.inf
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _topk_kernel(x_ref, out_v_ref, out_i_ref, run_v, run_i, *, k: int, blk: int, n: int):
+    """Grid dim 0 walks column blocks; scratch carries the running top-k."""
+    j = pl.program_id(0)
+    nblk = pl.num_programs(0)
+    t = x_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        run_v[:] = jnp.full((t, k), _NEG, jnp.float32)
+        run_i[:] = jnp.full((t, k), -1, jnp.int32)
+
+    block = x_ref[:].astype(jnp.float32)  # (T, BLK)
+    # mask out-of-range padding columns of the final block
+    col = jax.lax.broadcasted_iota(jnp.int32, (t, blk), 1) + j * blk
+    block = jnp.where(col < n, block, _NEG)
+
+    vals = jnp.concatenate([run_v[:], block], axis=1)  # (T, k+BLK)
+    idxs = jnp.concatenate([run_i[:], col], axis=1)
+
+    kcol = jax.lax.broadcasted_iota(jnp.int32, (t, k), 1)
+
+    def extract(i, carry):
+        vals, idxs, top_v, top_i = carry
+        am = jnp.argmax(vals, axis=1)  # (T,)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1) == am[:, None]
+        )
+        v = jnp.max(vals, axis=1)
+        gi = jnp.max(jnp.where(onehot, idxs, -1), axis=1)
+        # masked write of column i (dynamic_update_slice is not lowered on TPU)
+        top_v = jnp.where(kcol == i, v[:, None], top_v)
+        top_i = jnp.where(kcol == i, gi[:, None], top_i)
+        vals = jnp.where(onehot, _NEG, vals)
+        return vals, idxs, top_v, top_i
+
+    init = (
+        vals,
+        idxs,
+        jnp.full((t, k), _NEG, jnp.float32),
+        jnp.full((t, k), -1, jnp.int32),
+    )
+    _, _, top_v, top_i = jax.lax.fori_loop(0, k, extract, init)
+    run_v[:] = top_v
+    run_i[:] = top_i
+
+    @pl.when(j == nblk - 1)
+    def _emit():
+        out_v_ref[:] = run_v[:]
+        out_i_ref[:] = run_i[:]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "select_min", "blk", "interpret"))
+def topk_pallas(x, k: int, select_min: bool = True, blk: int = 2048,
+                interpret: bool | None = None):
+    """Top-k of each row of ``x`` (2-D) with source-column payloads.
+
+    Returns (values (m, k), indices (m, k) int32), values sorted best-first.
+    Exact; `select_min=True` mirrors lax.top_k on -x. ``interpret`` defaults
+    to True off-TPU (Pallas interpreter) so the kernel is testable on the CPU
+    mesh.
+    """
+    m, n = x.shape
+    if k > min(TOPK_MAX_K, n):
+        raise ValueError(f"k={k} must be <= min({TOPK_MAX_K}, n={n})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    xw = -x if select_min else x
+    blk = min(blk, _round_up(n, 128))
+    npad = _round_up(n, blk)
+    if npad != n:
+        xw = jnp.pad(xw, ((0, 0), (0, npad - n)), constant_values=_NEG)
+
+    grid = (npad // blk,)
+    out_v, out_i = pl.pallas_call(
+        functools.partial(_topk_kernel, k=k, blk=blk, n=n),
+        out_shape=(
+            jax.ShapeDtypeStruct((m, k), jnp.float32),
+            jax.ShapeDtypeStruct((m, k), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[pl.BlockSpec((m, blk), lambda j: (0, j), memory_space=pltpu.VMEM)],
+        out_specs=(
+            pl.BlockSpec((m, k), lambda j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, k), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((m, k), jnp.float32),
+            pltpu.VMEM((m, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(xw)
+    return (-out_v if select_min else out_v), out_i

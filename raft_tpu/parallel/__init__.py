@@ -2,10 +2,10 @@
 
 The reference ships the communicator and leaves distributed algorithms to
 consumers (cuML/cuGraph over raft::comms, docs/source/using_comms.rst); here
-the canonical ones are in-tree: sharded exact kNN with global merge, and
-multi-chip k-means.
+the canonical ones are in-tree: sharded exact kNN with global merge, multi-chip k-means, and
+list-sharded IVF-Flat search.
 """
 
-from . import kmeans, knn
+from . import ivf, kmeans, knn
 
-__all__ = ["knn", "kmeans"]
+__all__ = ["knn", "kmeans", "ivf"]

@@ -1,0 +1,117 @@
+"""Multi-chip IVF-Flat search: shard the inverted lists, probe locally,
+merge candidates over ICI.
+
+The reference leaves multi-GPU ANN serving to users composing raft::comms
+with per-shard indexes and knn_merge_parts (SURVEY.md §5; the cuML/cuGraph
+pattern over docs/source/using_comms.rst). Here it is a first-class driver:
+the padded list arrays (and their coarse centers) are sharded along
+``n_lists`` over the mesh axis; each chip ranks its own local centers and
+scans its local top-``n_probes`` lists, then one all_gather + select_k merge
+produces global results. Per-shard probing means each chip's scan work is
+identical (batch-synchronous, no load imbalance) and the effective probe
+count is ``size x n_probes`` local lists rather than a global top-n_probes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comms.comms import Comms, replicated, shard_along
+from ..core.errors import expects
+from ..distance.types import DistanceType
+from ..matrix.select_k import _select_k
+from ..neighbors.ivf_flat import IvfFlatIndex, SearchParams, _ivf_search
+
+__all__ = ["search"]
+
+
+def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
+    """Pad the index with empty lists so n_lists divides the mesh axis —
+    needed because sub-list splitting (_list_utils.split_oversized) makes
+    n_lists data-dependent. Padding centers sit at +1e30 so L2 coarse scores
+    rank them last; even if probed, their slots are all id -1 / +inf and
+    cannot win the merge. Inner-product has no constant worst-rank center (the
+    sign of q·c depends on q), so there the list count must already divide."""
+    L = index.n_lists
+    pad = (-L) % size
+    if pad == 0:
+        return index
+    expects(
+        index.metric != DistanceType.InnerProduct,
+        "inner-product distributed search needs n_lists (%d) divisible by the "
+        "mesh axis (%d) — rebuild with a different n_lists",
+        L, size,
+    )
+    d = index.dim
+    cap = index.capacity
+    return IvfFlatIndex(
+        centers=jnp.concatenate(
+            [index.centers, jnp.full((pad, d), 1e30, index.centers.dtype)]
+        ),
+        list_data=jnp.concatenate(
+            [index.list_data, jnp.zeros((pad, cap, d), index.list_data.dtype)]
+        ),
+        list_ids=jnp.concatenate(
+            [index.list_ids, jnp.full((pad, cap), -1, jnp.int32)]
+        ),
+        list_norms=jnp.concatenate(
+            [index.list_norms, jnp.full((pad, cap), jnp.inf, jnp.float32)]
+        ),
+        list_sizes=jnp.concatenate(
+            [index.list_sizes, jnp.zeros((pad,), jnp.int32)]
+        ),
+        metric=index.metric,
+    )
+
+
+def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: int):
+    """Distributed IVF-Flat search (multi-chip analogue of ivf_flat.search).
+
+    The index's lists are sharded along ``comms.axis``; every shard probes its
+    own ``n_probes`` best local lists and the candidates merge with one
+    all_gather + select_k. With L lists over S chips each chip scans
+    n_probes of its L/S lists, so total probed work is S x n_probes lists —
+    recall can only exceed the single-chip setting at equal ``n_probes``.
+
+    Returns replicated (distances (m, k), global ids (m, k)).
+    """
+    queries = jnp.asarray(queries)
+    size = comms.size()
+    index = _pad_lists_to_multiple(index, size)
+    L = index.n_lists
+    lists_per_shard = L // size
+    n_probes = min(params.n_probes, lists_per_shard)
+    expects(0 < k <= n_probes * index.capacity, "k exceeds per-shard candidate pool")
+    inner = index.metric == DistanceType.InnerProduct
+
+    def step(centers, data, ids, norms, sizes, q):
+        shard = IvfFlatIndex(centers, data, ids, norms, sizes, index.metric)
+        d_loc, i_loc = _ivf_search(
+            shard, q, n_probes, k,
+            query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
+            metric=index.metric,
+        )
+        d_all = comms.allgather(d_loc)  # (S, m, k) over ICI
+        i_all = comms.allgather(i_loc)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, not inner)
+
+    mesh, axis = comms.mesh, comms.axis
+    args = (
+        shard_along(mesh, axis, index.centers),
+        shard_along(mesh, axis, index.list_data),
+        shard_along(mesh, axis, index.list_ids),
+        shard_along(mesh, axis, index.list_norms),
+        shard_along(mesh, axis, index.list_sizes),
+        replicated(mesh, queries),
+    )
+    fn = comms.shard_map(
+        step,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(fn)(*args)

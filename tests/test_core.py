@@ -93,3 +93,27 @@ class TestSerialize:
 
 def test_mesh_fixture(mesh8):
     assert mesh8.size == 8
+
+
+def test_operators_vocabulary(rng):
+    """Reference operators.hpp parity: functors compose and KVP reductions
+    pick the right element."""
+    import jax.numpy as jnp
+    from raft_tpu.core import operators as ops
+
+    x = jnp.asarray(rng.random(16).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(ops.sq_op(x)), np.asarray(x) ** 2, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(ops.div_checkzero_op(x, jnp.zeros_like(x))), 0.0
+    )
+    f = ops.compose_op(ops.sqrt_op, ops.sq_op)
+    np.testing.assert_allclose(np.asarray(f(x)), np.abs(np.asarray(x)), rtol=1e-6)
+    add3 = ops.plug_const_op(3.0, ops.add_op)
+    np.testing.assert_allclose(np.asarray(add3(x)), np.asarray(x) + 3.0, rtol=1e-6)
+
+    a = ops.KeyValuePair(jnp.int32(1), jnp.float32(0.5))
+    b = ops.KeyValuePair(jnp.int32(2), jnp.float32(0.25))
+    r = ops.argmin_op(a, b)
+    assert int(r.key) == 2 and float(r.value) == 0.25
+    r = ops.argmax_op(a, b)
+    assert int(r.key) == 1 and float(r.value) == 0.5

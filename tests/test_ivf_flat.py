@@ -148,3 +148,102 @@ class TestSerialize:
             serialize_scalar(f, "ivf_pq")
         with pytest.raises(RaftError, match="not an ivf_flat"):
             ivf_flat.load(path)
+
+
+def test_bfloat16_list_storage(rng, tmp_path):
+    """bf16 list storage (halved scan bandwidth) keeps near-exact recall and
+    survives serialization."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import ivf_flat
+
+    n, d, m, k = 1500, 24, 40, 8
+    x = rng.random((n, d)).astype(np.float32)
+    q = rng.random((m, d)).astype(np.float32)
+    index = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=16, seed=0, list_dtype="bfloat16"), x
+    )
+    assert index.list_data.dtype == jnp.bfloat16
+    params = ivf_flat.SearchParams(n_probes=16)  # exhaustive
+    _, ids = ivf_flat.search(params, index, q, k)
+    d2 = ((q[:, None, :].astype(np.float64) - x[None]) ** 2).sum(-1)
+    want = np.argsort(d2, 1)[:, :k]
+    ids = np.asarray(ids)
+    recall = np.mean([len(set(ids[i]) & set(want[i])) / k for i in range(m)])
+    assert recall > 0.95, recall
+
+    # extend keeps the storage dtype; save/load roundtrip
+    index2 = ivf_flat.extend(index, rng.random((64, d)).astype(np.float32))
+    assert index2.list_data.dtype == jnp.bfloat16
+    path = str(tmp_path / "idx.bin")
+    ivf_flat.save(index2, path)
+    loaded = ivf_flat.load(path)
+    assert loaded.list_data.dtype == jnp.bfloat16
+
+
+def test_oversized_list_splitting(rng):
+    """A pathologically hot cluster must not inflate every list's capacity:
+    it splits into sub-lists sharing the center (_list_utils.split_oversized)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    # 1 dense blob (80% of data) + spread: massive skew
+    hot = rng.normal(0, 0.01, (1600, 8)).astype(np.float32)
+    rest = rng.normal(5, 2.0, (400, 8)).astype(np.float32)
+    x = np.concatenate([hot, rest])
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+    mean = 2000 / 16
+    assert index.capacity <= 2 * mean + 8, index.capacity
+    assert index.size == 2000  # nothing dropped
+
+    # search stays correct: probing everything == exact
+    q = x[::100]
+    params = ivf_flat.SearchParams(n_probes=index.n_lists)
+    dists, ids = ivf_flat.search(params, index, q, 5)
+    d2 = ((q[:, None, :].astype(np.float64) - x[None]) ** 2).sum(-1)
+    want = np.sort(d2, 1)[:, :5]
+    np.testing.assert_allclose(np.sort(np.asarray(dists), 1), want, atol=1e-2, rtol=1e-3)
+
+
+def test_split_oversized_unit(rng):
+    """Unit contract of _list_utils.split_oversized: capacity-bounded sub-list
+    relabeling that preserves membership and parent ordering."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors._list_utils import split_oversized
+
+    # list 0: 20 members, list 1: 3, list 2: 9; cap 8
+    labels = jnp.asarray(np.array([0] * 20 + [1] * 3 + [2] * 9, np.int32))
+    new_labels, rep = split_oversized(labels, 3, 8)
+    assert rep.tolist() == [3, 1, 2]
+    nl = np.asarray(new_labels)
+    # list 0 → sub-lists 0,1,2; list 1 → 3; list 2 → 4,5
+    assert set(nl[:20]) == {0, 1, 2}
+    assert set(nl[20:23]) == {3}
+    assert set(nl[23:]) == {4, 5}
+    # every sub-list holds at most cap members
+    assert np.bincount(nl).max() <= 8
+
+
+def test_forced_split_via_extend(rng):
+    """Extending a small-list index with skewed data triggers sub-list
+    splitting end-to-end (capacity stays bounded, search stays exact)."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors import ivf_flat
+
+    base = rng.random((64, 6)).astype(np.float32)
+    index = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), base)
+    # all new points land in one list: duplicates of one base vector
+    hot = np.tile(base[:1], (400, 1)) + rng.normal(0, 1e-3, (400, 6)).astype(np.float32)
+    index2 = ivf_flat.extend(index, hot)
+    mean = (64 + 400) / 8
+    assert index2.capacity <= 2 * mean + 8, index2.capacity
+    assert index2.n_lists > 8  # the hot list split
+    assert index2.size == 464
+    # exact search across the split index
+    q = np.concatenate([base[:4], hot[:4]])
+    dists, ids = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=index2.n_lists), index2, q, 3
+    )
+    all_x = np.concatenate([base, hot])
+    d2 = ((q[:, None, :].astype(np.float64) - all_x[None]) ** 2).sum(-1)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(dists), 1), np.sort(d2, 1)[:, :3], atol=1e-3, rtol=1e-3
+    )

@@ -28,12 +28,14 @@ class TestBuild:
     def test_index_structure(self, data):
         x, _ = data
         idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0), x)
-        assert idx.n_lists == 32
+        # hot lists may split into capacity-bounded sub-lists sharing a center
+        # (_list_utils.split_oversized), so n_lists is a lower bound
+        assert idx.n_lists >= 32
+        assert idx.capacity <= max(2 * 6000 // 32 + 8, 16)
         assert idx.pq_dim == 8
         assert idx.pq_len == 4  # 32 / 8
         assert idx.size == 6000
         assert idx.codebooks.shape == (8, 256, 4)
-        assert np.asarray(idx.list_sizes).min() > 0
 
     def test_pq_bits(self, data):
         x, _ = data
@@ -97,8 +99,9 @@ class TestSearch:
         idx = ivf_pq.build(
             ivf_pq.IndexParams(n_lists=16, pq_dim=8, codebook_kind="per_cluster", seed=0), x
         )
-        assert idx.codebooks.shape[0] == 16  # one codebook per list
-        _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, q, k=10)
+        # one codebook per list (sub-lists share their parent's codebook)
+        assert idx.codebooks.shape[0] == idx.n_lists >= 16
+        _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=idx.n_lists), idx, q, k=10)
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
         rec = _recall(np.asarray(i), true_i)
         # pq_dim=8 on d=32 is 4x compression; ~0.55 matches per_subspace at the
