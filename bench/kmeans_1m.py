@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""BASELINE config 2: k-means fit on make_blobs(1M x 128), k=1024, one chip.
+
+Counterpart of the reference's cluster bench (cpp/bench/prims/cluster/kmeans.cu)
+at the BASELINE.md table-2 operating point. Reports fit wall time (excluding
+the first-call compile, which is timed separately), per-iteration time, and
+inertia parity against the generating blob centers (the inertia of labeling
+every point by its true generator is the achievable floor; a correct Lloyd
+run from kmeans++ lands within a few percent of it).
+
+Usage: python bench/kmeans_1m.py [--n 1000000] [--k 1024] [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--d", type=int, default=128)
+    ap.add_argument("--k", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.cluster import kmeans
+    from raft_tpu.random import make_blobs
+
+    rng = np.random.default_rng(0)
+    true_centers = rng.uniform(-10.0, 10.0, (args.k, args.d)).astype(np.float32)
+    x, _ = make_blobs(args.n, args.d, centers=true_centers, cluster_std=1.0, seed=0)
+    jax.block_until_ready(x)
+
+    # inertia floor: cost of the generating centers
+    floor = float(kmeans.cluster_cost(x, true_centers))
+
+    params = kmeans.KMeansParams(
+        n_clusters=args.k, max_iter=args.iters, tol=0.0, init="kmeans++", seed=0
+    )
+
+    t0 = time.perf_counter()
+    out = kmeans.fit(params, x)
+    np.asarray(out.centroids)
+    first = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out = kmeans.fit(params, x)
+    np.asarray(out.centroids)
+    fit_s = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": f"kmeans fit {args.n}x{args.d} k={args.k} ({args.iters} iters)",
+                "fit_s": round(fit_s, 2),
+                "first_call_s": round(first, 2),
+                "s_per_iter": round(fit_s / max(int(out.n_iter), 1), 3),
+                "n_iter": int(out.n_iter),
+                "inertia": float(out.inertia),
+                "inertia_floor": floor,
+                "inertia_ratio": round(float(out.inertia) / floor, 4) if floor else None,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
