@@ -40,14 +40,24 @@ __all__ = ["pairwise_distance", "distance"]
 
 _f32 = jnp.float32
 
+# MXU contraction precision for f32 operands. "float32" is 6-pass bf16
+# emulation (bit-accurate f32 products, the reference's cuBLAS-f32
+# equivalent); "bfloat16" is the native single-pass MXU mode — ~2^-8
+# relative error on products, ~6x the contraction throughput. kNN exposes
+# this as `compute=` (ordering, not values, is what matters there).
+_PRECISIONS = {
+    "float32": lax.Precision.HIGHEST,
+    "bfloat16": lax.Precision.DEFAULT,
+}
 
-def _dot(x, y):
+
+def _dot(x, y, prec=lax.Precision.HIGHEST):
     """MXU inner-product block: (m,d)@(d,n) with f32 accumulation."""
     return lax.dot_general(
         x,
         y,
         (((1,), (0,)), ((), ())),
-        precision=lax.Precision.HIGHEST,
+        precision=prec,
         preferred_element_type=_f32,
     )
 
@@ -61,67 +71,67 @@ def _row_norms_sq(x):
 # ---------------------------------------------------------------------------
 
 
-def _l2_expanded(x, y, sqrt: bool):
+def _l2_expanded(x, y, sqrt: bool, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/l2_exp.cuh — xn + yn - 2·x·y, clamped at 0 before sqrt.
-    d2 = _row_norms_sq(x)[:, None] + _row_norms_sq(y)[None, :] - 2.0 * _dot(x, y.T)
+    d2 = _row_norms_sq(x)[:, None] + _row_norms_sq(y)[None, :] - 2.0 * _dot(x, y.T, prec)
     d2 = jnp.maximum(d2, 0.0)
     return jnp.sqrt(d2) if sqrt else d2
 
 
-def _cosine(x, y):
+def _cosine(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/cosine.cuh — 1 - x·y / (‖x‖‖y‖).
     xn = jnp.sqrt(_row_norms_sq(x))
     yn = jnp.sqrt(_row_norms_sq(y))
-    return 1.0 - _dot(x, y.T) / (xn[:, None] * yn[None, :])
+    return 1.0 - _dot(x, y.T, prec) / (xn[:, None] * yn[None, :])
 
 
-def _correlation(x, y):
+def _correlation(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/correlation.cuh — 1 - Pearson r (centered cosine).
     xc = x.astype(_f32) - jnp.mean(x, axis=1, dtype=_f32)[:, None]
     yc = y.astype(_f32) - jnp.mean(y, axis=1, dtype=_f32)[:, None]
-    return _cosine(xc, yc)
+    return _cosine(xc, yc, prec)
 
 
-def _inner_product(x, y):
+def _inner_product(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops cover IP via CUTLASS path; raw inner product, not 1-ip.
-    return _dot(x, y.T)
+    return _dot(x, y.T, prec)
 
 
-def _hellinger(x, y):
+def _hellinger(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/hellinger.cuh — sqrt(max(0, 1 - Σ√(xᵢyᵢ))).
-    acc = _dot(jnp.sqrt(x.astype(_f32)), jnp.sqrt(y.astype(_f32)).T)
+    acc = _dot(jnp.sqrt(x.astype(_f32)), jnp.sqrt(y.astype(_f32)).T, prec)
     return jnp.sqrt(jnp.maximum(1.0 - acc, 0.0))
 
 
-def _russelrao(x, y):
+def _russelrao(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/russel_rao.cuh — (k - x·y)/k, k = n_features.
     k = x.shape[1]
-    return (k - _dot(x, y.T)) / k
+    return (k - _dot(x, y.T, prec)) / k
 
 
-def _kl_divergence(x, y):
+def _kl_divergence(x, y, prec=lax.Precision.HIGHEST):
     # ref: distance_ops/kl_divergence.cuh — 0.5·Σ x(log x - log y) with
     # zero-guards: terms with x==0 vanish; log y is treated as 0 where y==0.
     xf = x.astype(_f32)
     yf = y.astype(_f32)
     xlogx = jnp.sum(jnp.where(xf > 0, xf * jnp.log(jnp.where(xf > 0, xf, 1.0)), 0.0), axis=1)
     glog_y = jnp.where(yf > 0, jnp.log(jnp.where(yf > 0, yf, 1.0)), 0.0)
-    return 0.5 * (xlogx[:, None] - _dot(x, glog_y.T))
+    return 0.5 * (xlogx[:, None] - _dot(x, glog_y.T, prec))
 
 
-def _jaccard(x, y):
+def _jaccard(x, y, prec=lax.Precision.HIGHEST):
     # Binary-set semantics (reference keeps Jaccard in the sparse stack,
     # sparse/distance; provided densely here): 1 - |x∧y| / |x∨y|.
-    inter = _dot(x, y.T)
+    inter = _dot(x, y.T, prec)
     sx = jnp.sum(x.astype(_f32), axis=1)
     sy = jnp.sum(y.astype(_f32), axis=1)
     union = sx[:, None] + sy[None, :] - inter
     return jnp.where(union > 0, 1.0 - inter / jnp.where(union > 0, union, 1.0), 0.0)
 
 
-def _dice(x, y):
+def _dice(x, y, prec=lax.Precision.HIGHEST):
     # Binary-set semantics: 1 - 2|x∧y| / (|x| + |y|).
-    inter = _dot(x, y.T)
+    inter = _dot(x, y.T, prec)
     sx = jnp.sum(x.astype(_f32), axis=1)
     sy = jnp.sum(y.astype(_f32), axis=1)
     tot = sx[:, None] + sy[None, :]
@@ -228,28 +238,30 @@ def _tiled_rows(x, y, fn, tile: int):
     return out.reshape(num * tile, n)[:m]
 
 
-@functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "tile"))
-def _pairwise(x, y, metric: DistanceType, metric_arg: float, tile: int):
+@functools.partial(jax.jit, static_argnames=("metric", "metric_arg", "tile", "compute"))
+def _pairwise(x, y, metric: DistanceType, metric_arg: float, tile: int,
+              compute: str = "float32"):
+    prec = _PRECISIONS[compute]
     if metric == DistanceType.L2Expanded:
-        return _l2_expanded(x, y, sqrt=False)
+        return _l2_expanded(x, y, sqrt=False, prec=prec)
     if metric == DistanceType.L2SqrtExpanded:
-        return _l2_expanded(x, y, sqrt=True)
+        return _l2_expanded(x, y, sqrt=True, prec=prec)
     if metric == DistanceType.CosineExpanded:
-        return _cosine(x, y)
+        return _cosine(x, y, prec)
     if metric == DistanceType.CorrelationExpanded:
-        return _correlation(x, y)
+        return _correlation(x, y, prec)
     if metric == DistanceType.InnerProduct:
-        return _inner_product(x, y)
+        return _inner_product(x, y, prec)
     if metric == DistanceType.HellingerExpanded:
-        return _hellinger(x, y)
+        return _hellinger(x, y, prec)
     if metric == DistanceType.RusselRaoExpanded:
-        return _russelrao(x, y)
+        return _russelrao(x, y, prec)
     if metric == DistanceType.KLDivergence:
-        return _kl_divergence(x, y)
+        return _kl_divergence(x, y, prec)
     if metric == DistanceType.JaccardExpanded:
-        return _jaccard(x, y)
+        return _jaccard(x, y, prec)
     if metric == DistanceType.DiceExpanded:
-        return _dice(x, y)
+        return _dice(x, y, prec)
 
     ew = {
         DistanceType.L1: _ew_l1,
@@ -267,7 +279,8 @@ def _pairwise(x, y, metric: DistanceType, metric_arg: float, tile: int):
 
 
 @auto_convert_output
-def pairwise_distance(x, y=None, metric="euclidean", metric_arg: float = 2.0, res: Resources | None = None):
+def pairwise_distance(x, y=None, metric="euclidean", metric_arg: float = 2.0,
+                      compute: str = "float32", res: Resources | None = None):
     """Compute all-pairs distances between the rows of ``x`` and ``y``.
 
     Reference: raft::distance::pairwise_distance (distance-inl.cuh:238) and the
@@ -276,7 +289,10 @@ def pairwise_distance(x, y=None, metric="euclidean", metric_arg: float = 2.0, re
 
     Parameters mirror pylibraft: ``metric`` is a string from
     :data:`SUPPORTED_DISTANCES` or a :class:`DistanceType`; ``metric_arg`` is
-    the Minkowski ``p``.
+    the Minkowski ``p``. ``compute`` selects the MXU contraction mode for the
+    GEMM-shaped metrics (L2/cosine/correlation/inner-product): "float32"
+    (default, bit-accurate products) or "bfloat16" (single-pass MXU, ~6x the
+    contraction throughput, ~2^-8 relative error on the dot term).
     """
     res = res or default_resources()
     mt = resolve_metric(metric)
@@ -291,8 +307,9 @@ def pairwise_distance(x, y=None, metric="euclidean", metric_arg: float = 2.0, re
     )
     if mt == DistanceType.Haversine:
         expects(x.shape[1] == 2, "haversine requires (lat, lon) inputs with d == 2")
+    expects(compute in _PRECISIONS, "compute must be 'float32' or 'bfloat16', got %r", compute)
     tile = _choose_tile(x.shape[0], y.shape[0], x.shape[1], res.workspace_bytes)
-    return _pairwise(x, y, mt, float(metric_arg), tile)
+    return _pairwise(x, y, mt, float(metric_arg), tile, compute)
 
 
 # pylibraft exposes the same call as `distance(...)` (pairwise_distance.pyx:93).

@@ -22,7 +22,7 @@ from jax import lax
 
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
-from ..distance.pairwise import _choose_tile, _pairwise, _pad_to_tiles
+from ..distance.pairwise import _PRECISIONS, _choose_tile, _pairwise, _pad_to_tiles
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import select_k
 
@@ -30,10 +30,12 @@ __all__ = ["knn", "knn_merge_parts", "BruteForce"]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "metric_arg", "tile", "inner_tile", "approx")
+    jax.jit,
+    static_argnames=("k", "metric", "metric_arg", "tile", "inner_tile", "approx", "compute"),
 )
 def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float,
-            tile: int, inner_tile: int, keep_mask=None, approx: bool = False):
+            tile: int, inner_tile: int, keep_mask=None, approx: bool = False,
+            compute: str = "float32"):
     m = queries.shape[0]
     n = dataset.shape[0]
     # kNN ordering is identical under expanded vs unexpanded L2, so route the
@@ -47,7 +49,7 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float,
     select_min = metric != DistanceType.InnerProduct
 
     def body(qb):
-        d = _pairwise(qb, dataset, metric, metric_arg, inner_tile)  # (tile, n)
+        d = _pairwise(qb, dataset, metric, metric_arg, inner_tile, compute)  # (tile, n)
         if keep_mask is not None:
             # fused predicate filter (ref: neighbors/sample_filter_types.hpp)
             d = jnp.where(keep_mask[None, :], d, jnp.inf if select_min else -jnp.inf)
@@ -76,13 +78,17 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float,
 
 @auto_convert_output
 def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
-        sample_filter=None, mode: str = "exact", res: Resources | None = None):
+        sample_filter=None, mode: str = "exact", compute: str = "float32",
+        res: Resources | None = None):
     """Exact kNN of ``queries`` in ``dataset`` (reference:
     brute_force::knn, neighbors/brute_force.cuh; pylibraft
     neighbors/brute_force.pyx knn). ``sample_filter`` is an optional
     :class:`~raft_tpu.neighbors.sample_filter.BitsetFilter` / boolean keep-mask
     over dataset rows. ``mode``: "exact" (sort-based TopK) or "approx"
-    (TPU PartialReduce, ≥0.99 expected recall, ~2x faster).
+    (TPU PartialReduce, ≥0.99 expected recall, ~2x faster). ``compute``:
+    "float32" (bit-accurate distances) or "bfloat16" (single-pass MXU
+    contraction — same neighbor ordering in all but razor-thin margins,
+    several times the GEMM throughput).
     Returns (distances (m, k), indices (m, k))."""
     from .sample_filter import resolve_filter
 
@@ -94,6 +100,8 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     n = dataset.shape[0]
     expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
     expects(mode in ("exact", "approx"), "mode must be 'exact' or 'approx', got %r", mode)
+    expects(compute in _PRECISIONS,
+            "compute must be one of %s, got %r", sorted(_PRECISIONS), compute)
     mt = resolve_metric(metric)
     keep_mask = resolve_filter(sample_filter)
     if keep_mask is not None:
@@ -103,7 +111,7 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     tile = _choose_tile(queries.shape[0], n, 1, res.workspace_bytes)
     inner_tile = _choose_tile(tile, n, dataset.shape[1], res.workspace_bytes)
     return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile,
-                   keep_mask, approx=mode == "approx")
+                   keep_mask, approx=mode == "approx", compute=compute)
 
 
 def knn_merge_parts(part_dists, part_ids, k: int | None = None, select_min: bool = True):

@@ -144,3 +144,34 @@ def test_knn_approx_mode(rng):
 
     with _pytest.raises(Exception):
         knn(x, q, 10, mode="bogus")
+
+
+def test_knn_bfloat16_compute(rng):
+    """compute='bfloat16' (single-pass MXU contraction) preserves neighbor
+    ordering on data with non-degenerate margins and rejects bad values."""
+    from raft_tpu.neighbors import knn
+
+    x = (10.0 * rng.random((1500, 32))).astype(np.float32)
+    q = (10.0 * rng.random((40, 32))).astype(np.float32)
+    d_b, i_b = knn(x, q, 10, compute="bfloat16")
+    d_e, i_e = knn(x, q, 10, compute="float32")
+    recall = np.mean([
+        len(set(np.asarray(i_b)[i]) & set(np.asarray(i_e)[i])) / 10 for i in range(40)
+    ])
+    assert recall > 0.9
+    # distances stay close in relative terms
+    np.testing.assert_allclose(np.asarray(d_b), np.asarray(d_e), rtol=0.05, atol=0.5)
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        knn(x, q, 10, compute="float16")
+
+
+def test_pairwise_compute_knob(rng):
+    from raft_tpu.distance import pairwise_distance
+
+    x = rng.random((64, 16)).astype(np.float32)
+    y = rng.random((48, 16)).astype(np.float32)
+    d_b = np.asarray(pairwise_distance(x, y, metric="cosine", compute="bfloat16"))
+    d_e = np.asarray(pairwise_distance(x, y, metric="cosine", compute="float32"))
+    np.testing.assert_allclose(d_b, d_e, atol=2e-2)
