@@ -52,10 +52,22 @@ class IndexParams:
     intermediate_graph_degree: int = 64  # ref :55
     graph_degree: int = 32  # ref :57
     metric: str | DistanceType = "sqeuclidean"
-    build_pq_bits: int = 8
+    # 0 → auto: pq_bits=4 when the dataset is high-dimensional (pq_dim >= 32,
+    # e.g. d >= 64) — the TPU-fast LUT scan (one-hot contraction axis 16
+    # codes, ~10x the pq8 QPS), with graph quality restored by the exact
+    # refine pass — and pq_bits=8 for low-dim data where 16 codes per
+    # subspace quantize too coarsely and the pq8 axis is cheap anyway (the
+    # reference always uses 8; its smem LUT is bits-insensitive).
+    build_pq_bits: int = 0
     build_n_lists: int = 0  # 0 → sqrt(n) heuristic
     build_n_probes: int = 32
-    refine_rate: float = 2.0  # ref cagra_build.cuh:99 gpu_top_k multiplier
+    # gpu_top_k multiplier (ref cagra_build.cuh:99 defaults 2.0 against pq8);
+    # 3.0 compensates pq4's coarser candidate ordering — the wider exact
+    # refine pool costs far less than pq8's 10x-slower LUT scan
+    refine_rate: float = 3.0
+    # query rows per device dispatch during the self-search/refine phases —
+    # keeps any single device program under watchdog/VMEM pressure limits
+    build_chunk: int = 16384
     seed: int = 0
 
 
@@ -66,6 +78,14 @@ class SearchParams:
     itopk_size: int = 64  # beam width (ref :66)
     max_iterations: int = 0  # 0 → auto (ref :71)
     search_width: int = 1  # beam entries expanded per hop (ref :93)
+    # entry-point candidate pool: the beam is seeded with the best
+    # `n_init` of `seed_pool` uniformly-sampled dataset points, scored by one
+    # (m, seed_pool) MXU GEMM. The reference seeds from `num_pickup` purely
+    # random points (search_plan.cuh random_samplings); a scored pool costs
+    # one cheap matmul and keeps recall on clustered data where random
+    # entries land in the wrong basin and the graph has no cross-cluster
+    # edges. 0 → plain random entries (reference behavior).
+    seed_pool: int = 4096
 
 
 @jax.tree_util.register_pytree_node_class
@@ -108,28 +128,38 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     gpu_top_k = min(int(k * params.refine_rate), n - 1)
 
     n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
+    pq_bits = params.build_pq_bits or (4 if ivf_pq_mod._default_pq_dim(d) >= 32 else 8)
     pq = ivf_pq_mod.build(
         ivf_pq_mod.IndexParams(
             n_lists=min(n_lists, n // 4 if n >= 32 else n),
             metric=params.metric,
-            pq_bits=params.build_pq_bits,
+            pq_bits=pq_bits,
             seed=params.seed,
         ),
         x,
         res=res,
     )
-    # query the dataset against itself; k+1 then drop self
-    _, cand = ivf_pq_mod.search(
-        ivf_pq_mod.SearchParams(n_probes=params.build_n_probes), pq, x, gpu_top_k + 1, res=res
-    )
-    _, refined = refine(x, x, cand, k + 1, metric=params.metric, res=res)
-    # drop self-edges (ref: build_knn_graph removes the query itself)
-    self_col = refined == jnp.arange(n, dtype=jnp.int32)[:, None]
-    # shift left past self matches: mask self then take first k valid
-    big = jnp.where(self_col, jnp.iinfo(jnp.int32).max, jnp.arange(k + 1, dtype=jnp.int32)[None, :])
-    order = jnp.argsort(big, axis=1)[:, :k]
-    graph = jnp.take_along_axis(refined, order, axis=1)
-    return graph
+    # query the dataset against itself in host-side chunks (one giant
+    # dispatch trips device watchdogs at 100k+ rows; the reference batches
+    # here too — cagra_build.cuh:86 loops over max_batch_size query blocks),
+    # k+1 then drop self
+    sp = ivf_pq_mod.SearchParams(n_probes=params.build_n_probes)
+    chunk = max(int(params.build_chunk), 1024)
+    parts = []
+    for s in range(0, n, chunk):
+        xb = x[s:s + chunk]
+        _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1, res=res)
+        _, refined = refine(x, xb, cand, k + 1, metric=params.metric, res=res)
+        # drop self-edges (ref: build_knn_graph removes the query itself)
+        rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
+        self_col = refined == rows[:, None]
+        # shift left past self matches: mask self then take first k valid
+        big = jnp.where(
+            self_col, jnp.iinfo(jnp.int32).max, jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        )
+        order = jnp.argsort(big, axis=1)[:, :k]
+        parts.append(jnp.take_along_axis(refined, order, axis=1))
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
 
 @functools.partial(jax.jit, static_argnames=("out_degree", "tile"))
@@ -173,24 +203,28 @@ def _reverse_merge(graph, out_degree: int):
     fwd_keep = out_degree - out_degree // 2
     rev_keep = out_degree // 2
 
-    # reverse edge priority: rank of u in v's list (lower = stronger)
+    # reverse edge priority: rank of u in v's list (lower = stronger).
+    # Scatter-free formulation: a (dst, rank) scatter over n·k updates
+    # serializes on TPU (measured 520 s at 100k x 64 — XLA lowers
+    # non-trivial scatters to a sequential loop). Instead sort edges once by
+    # the combined key dst·k + rank (unique, so one stable sort orders by
+    # (dst, rank)), then for each destination v GATHER its best incoming
+    # sources from the contiguous run [searchsorted(v·k), +rev_keep) — sort +
+    # binary search + gather are all TPU-native.
+    expects(n * k < 2 ** 31, "reverse merge packs dst*degree+rank into int32; "
+            "n*degree=%d overflows — shard the graph first", n * k)
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
-    dst = graph.reshape(-1)
-    rank = jnp.tile(jnp.arange(k, dtype=jnp.int32), n)
-    # for each destination, keep its best rev_keep incoming edges:
-    # sort by (dst, rank) then segment-select
-    order = jnp.lexsort((rank, dst))
-    s_dst = dst[order]
-    s_src = src[order]
-    # position within destination group
-    first = jnp.concatenate([jnp.array([True]), s_dst[1:] != s_dst[:-1]])
-    grp_start = jnp.where(first, jnp.arange(n * k), 0)
-    grp_start = lax.associative_scan(jnp.maximum, grp_start)
-    pos = jnp.arange(n * k) - grp_start
-    valid = pos < rev_keep
-    rev = jnp.full((n, rev_keep), -1, jnp.int32)
-    # invalid updates are routed out of bounds and dropped
-    rev = rev.at[jnp.where(valid, s_dst, n), jnp.where(valid, pos, 0)].set(s_src, mode="drop")
+    key = graph.reshape(-1).astype(jnp.int32) * k + jnp.tile(
+        jnp.arange(k, dtype=jnp.int32), n
+    )
+    s_key, s_src = lax.sort((key, src), num_keys=1)
+    starts = jnp.searchsorted(s_key, jnp.arange(n, dtype=jnp.int32) * k)  # (n,)
+    # ends[v] == starts[v+1] (all keys < n*k) — no second binary-search sweep
+    ends = jnp.concatenate([starts[1:], jnp.array([n * k], starts.dtype)])
+    offs = jnp.arange(rev_keep, dtype=jnp.int32)[None, :]
+    take = starts[:, None] + offs  # (n, rev_keep)
+    valid = take < ends[:, None]
+    rev = jnp.where(valid, jnp.take(s_src, jnp.minimum(take, n * k - 1)), -1)
 
     merged = jnp.concatenate([graph[:, :fwd_keep], rev], axis=1)
     # fill -1 slots (nodes with few reverse edges) from remaining fwd edges
@@ -237,9 +271,12 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out", "seed_pool"),
+)
 def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
-                  search_width: int, sqrt_out: bool):
+                  search_width: int, sqrt_out: bool, seed_pool: int = 4096):
     n, d = index.dataset.shape
     m = queries.shape[0]
     deg = index.graph_degree
@@ -256,12 +293,27 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
                           precision=lax.Precision.HIGHEST)
         return dn2[ids] - 2.0 * dots  # + ‖q‖² added at the end
 
-    # ---- init beam: random entry points (ref: search_plan random_samplings) ----
+    # ---- init beam: entry points (ref: search_plan random_samplings) ----
     key = jax.random.key(0)
     n_init = min(max(itopk, exp_per_hop), n)
-    init_ids = jax.random.choice(key, n, (n_init,), replace=False)
-    init_ids = jnp.broadcast_to(init_ids[None, :], (m, n_init)).astype(jnp.int32)
-    init_d = dist_to(qf, init_ids)
+    pool = min(int(seed_pool), n)  # small datasets: score every point
+    if pool > n_init:
+        # score a sampled pool with one MXU GEMM, seed per-query best entries
+        pool_ids = jax.random.choice(key, n, (pool,), replace=False).astype(jnp.int32)
+        pool_vecs = data[pool_ids].astype(jnp.float32)  # (S, d)
+        pool_d = dn2[pool_ids][None, :] - 2.0 * jnp.einsum(
+            "md,sd->ms", qf, pool_vecs, precision=lax.Precision.DEFAULT
+        )  # (m, S)
+        _, best = lax.top_k(-pool_d, n_init)
+        init_ids = pool_ids[best]  # (m, n_init), per-query seeds
+        # re-score selected seeds exactly: the bf16 pool scores only pick
+        # entries; beam/output distances must match the expanded nodes'
+        # HIGHEST-precision scale or near-tie dedup keeps the wrong copy
+        init_d = dist_to(qf, init_ids)
+    else:
+        init_ids = jax.random.choice(key, n, (n_init,), replace=False)
+        init_ids = jnp.broadcast_to(init_ids[None, :], (m, n_init)).astype(jnp.int32)
+        init_d = dist_to(qf, init_ids)
 
     pad = itopk + exp_per_hop - n_init
     beam_ids = jnp.pad(init_ids, ((0, 0), (0, max(pad, 0))), constant_values=-1)[:, : itopk + exp_per_hop]
@@ -345,7 +397,7 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     max_iter = params.max_iterations or (itopk // max(params.search_width, 1) + 10)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
     return _cagra_search(index, queries, int(k), int(itopk), int(max_iter),
-                         int(params.search_width), sqrt_out)
+                         int(params.search_width), sqrt_out, int(params.seed_pool))
 
 
 def save(index: CagraIndex, path: str) -> None:
