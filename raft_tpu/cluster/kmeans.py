@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -113,28 +114,37 @@ def _lloyd(x, init_centroids, weights, k: int, max_iter: int, tol: float, tile: 
 
 @functools.partial(jax.jit, static_argnames=("k", "tile"))
 def _kmeans_plus_plus(x, key, k: int, tile: int):
-    """k-means++ seeding (ref: kmeansPlusPlus, cluster/detail/kmeans.cuh:90).
+    """Greedy k-means++ seeding (ref: kmeansPlusPlus, cluster/detail/
+    kmeans.cuh:90 — batched trials at :113-255, n_trials = 2 + ⌈log k⌉).
 
-    lax.fori_loop over k steps; each step draws the next center with
-    probability ∝ current min squared distance — the exact D² sampling the
-    reference implements with batched trials.
+    lax.fori_loop over k steps; each step draws ``n_trials`` candidates with
+    probability ∝ current min squared distance (D² sampling) and keeps the
+    one that lowers total cost most. Plain 1-trial D² sampling merges
+    clusters at large k (e.g. ~2.3x the inertia floor on 1024 separated
+    blobs); greedy trials are what the reference and sklearn use to avoid
+    that. Each step is one (T, n) MXU contraction.
     """
+    from ..distance.pairwise import _l2_expanded
+
     n, d = x.shape
+    trials = 2 + int(math.ceil(math.log(max(k, 2))))
     xf = x.astype(jnp.float32)
     key, k0 = jax.random.split(key)
     first = jax.random.randint(k0, (), 0, n)
     centers = jnp.zeros((k, d), jnp.float32).at[0].set(xf[first])
-    mind2 = jnp.sum(jnp.square(xf - xf[first][None, :]), axis=1)
+    mind2 = _l2_expanded(xf[first][None, :], xf, sqrt=False)[0]  # (n,), HIGHEST prec
 
     def body(i, carry):
         centers, mind2, key = carry
         key, kc = jax.random.split(key)
         logits = jnp.log(jnp.maximum(mind2, 1e-30))
-        nxt = jax.random.categorical(kc, logits)
-        c = xf[nxt]
-        centers = centers.at[i].set(c)
-        mind2 = jnp.minimum(mind2, jnp.sum(jnp.square(xf - c[None, :]), axis=1))
-        return centers, mind2, key
+        cand = jax.random.categorical(kc, logits, shape=(trials,))  # (T,)
+        cvec = xf[cand]  # (T, d)
+        d2 = _l2_expanded(cvec, xf, sqrt=False)  # (T, n)
+        newmin = jnp.minimum(mind2[None, :], d2)  # (T, n)
+        best = jnp.argmin(jnp.sum(newmin, axis=1))
+        centers = centers.at[i].set(cvec[best])
+        return centers, newmin[best], key
 
     centers, _, _ = lax.fori_loop(1, k, body, (centers, mind2, key))
     return centers
