@@ -26,7 +26,7 @@ from jax import lax
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..distance.fused_nn import _fused_l2_nn
-from ..distance.pairwise import _choose_tile, pairwise_distance
+from ..distance.pairwise import _choose_tile, _l2_expanded, pairwise_distance
 from ..random.rng import as_key
 
 __all__ = [
@@ -124,8 +124,6 @@ def _kmeans_plus_plus(x, key, k: int, tile: int):
     blobs); greedy trials are what the reference and sklearn use to avoid
     that. Each step is one (T, n) MXU contraction.
     """
-    from ..distance.pairwise import _l2_expanded
-
     n, d = x.shape
     trials = 2 + int(math.ceil(math.log(max(k, 2))))
     xf = x.astype(jnp.float32)

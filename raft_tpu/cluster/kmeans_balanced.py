@@ -73,11 +73,23 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         # -- balancing (ref: adjust_centers :524) --
         avg = n / k
         small = counts < (avg * small_ratio)  # (k,)
-        key, kc = jax.random.split(key)
-        # draw replacement points, favoring members of crowded clusters
-        point_w = counts[labels]  # crowdedness of each point's cluster
-        logits = jnp.log(jnp.maximum(point_w, 1e-6))
-        repl_idx = jax.random.categorical(kc, logits, shape=(k,))
+        key, kc, kp = jax.random.split(key, 3)
+        # draw replacement points, favoring members of crowded clusters.
+        # categorical(shape=(k,)) over all n logits broadcasts a (k, n)
+        # gumbel block — 2 GB/iter at 500k x 1024 and the dominant cost of
+        # the whole EM loop. Instead draw from a small uniform pool of
+        # candidate points re-weighted by their cluster's crowdedness: same
+        # bias, (k, pool) work.
+        pool = min(max(4 * k, 4096), n)
+        pool_idx = jax.random.randint(kp, (pool,), 0, n)
+        pool_w = counts[labels[pool_idx]]  # crowdedness of each candidate
+        logits = jnp.log(jnp.maximum(pool_w, 1e-6))
+        # Gumbel top-k = weighted sampling WITHOUT replacement: k distinct
+        # candidates, so two small clusters never re-seed to the same point
+        # (a duplicated center starves one of them permanently)
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(kc, (pool,), minval=1e-20, maxval=1.0)))
+        repl_idx = pool_idx[lax.top_k(logits + gumbel, k)[1]]
         repl = xf[repl_idx]
         centers = jnp.where(small[:, None], repl, centers)
 
