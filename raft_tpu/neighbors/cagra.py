@@ -321,28 +321,18 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
     beam_visited = jnp.zeros(beam_ids.shape, jnp.bool_)
 
     def dedup_sort(ids, dists, visited):
-        """Sort by distance; kill duplicate ids (keep first). The TPU form of
-        the reference's visited hashmap + bitonic itopk."""
-        order = jnp.argsort(dists, axis=1, stable=True)
-        ids = jnp.take_along_axis(ids, order, axis=1)
-        dists = jnp.take_along_axis(dists, order, axis=1)
-        visited = jnp.take_along_axis(visited, order, axis=1)
-        # mark duplicates: same id as an earlier (closer) entry
-        id_order = jnp.argsort(ids, axis=1, stable=True)
-        sid = jnp.take_along_axis(ids, id_order, axis=1)
-        dup_sorted = jnp.concatenate(
+        """Distance-sorted beam with duplicate ids killed (keep closest) —
+        the TPU form of the reference's visited hashmap + bitonic itopk.
+        Two multi-operand lax.sorts (payloads carried in-sort, no argsort +
+        gather rounds): (id, dist)-lexsort groups duplicates with the
+        closest copy first, then a dist-sort restores beam order."""
+        sid, sd, sv = lax.sort((ids, dists, visited), dimension=1, num_keys=2)
+        dup = jnp.concatenate(
             [jnp.zeros((ids.shape[0], 1), jnp.bool_), sid[:, 1:] == sid[:, :-1]], axis=1
         )
-        dup = jnp.zeros_like(dup_sorted).at[
-            jnp.arange(ids.shape[0])[:, None], id_order
-        ].set(dup_sorted)
-        dists = jnp.where(dup | (ids < 0), jnp.inf, dists)
-        order2 = jnp.argsort(dists, axis=1, stable=True)
-        return (
-            jnp.take_along_axis(ids, order2, axis=1),
-            jnp.take_along_axis(dists, order2, axis=1),
-            jnp.take_along_axis(visited, order2, axis=1),
-        )
+        sd = jnp.where(dup | (sid < 0), jnp.inf, sd)
+        sd2, sid2, sv2 = lax.sort((sd, sid, sv), dimension=1, num_keys=1)
+        return sid2, sd2, sv2
 
     beam_ids, beam_d, beam_visited = dedup_sort(beam_ids, beam_d, beam_visited)
 
