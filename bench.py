@@ -46,10 +46,17 @@ def main():
     warm = jnp.asarray(rng.random((n_batches, m, d), np.float32))
     np.asarray(jax.tree_util.tree_leaves(chained(warm))[0])
 
-    t0 = time.perf_counter()
-    out = chained(batches)
-    np.asarray(jax.tree_util.tree_leaves(out)[0])  # host materialization
-    dt = time.perf_counter() - t0
+    # best of 3: tunnel RPC latency and transient device contention add
+    # tens-of-percent run-to-run noise; min is the standard de-noiser
+    batch_sets = [batches] + [
+        jnp.asarray(rng.random((n_batches, m, d), np.float32)) for _ in range(2)
+    ]
+    dt = float("inf")
+    for bs in batch_sets:
+        t0 = time.perf_counter()
+        out = chained(bs)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])  # host materialization
+        dt = min(dt, time.perf_counter() - t0)
 
     qps = n_batches * m / dt
     print(
