@@ -72,10 +72,12 @@ def split_oversized(labels, n_lists: int, cap_target: int):
     return new_labels, rep
 
 
-def bound_capacity(labels, n_lists: int):
-    """Shared capacity policy for IVF fills: lists larger than 2x the mean
-    split into sub-lists (see :func:`split_oversized`); otherwise capacity is
-    the max size rounded to the sublane tile.
+def bound_capacity(labels, n_lists: int, factor: float = 2.0):
+    """Shared capacity policy for IVF fills: lists larger than ``factor`` x
+    the mean split into sub-lists (see :func:`split_oversized`); otherwise
+    capacity is the max size rounded to the sublane tile. Lower factors cut
+    the padded-gather bytes every scan pays (the 1M-scale search bottleneck)
+    at the cost of more sub-lists competing for probe slots.
 
     Returns ``(labels, rep, n_lists, capacity)`` where ``rep`` is None when no
     splitting happened, else the host repeat-count array for center-indexed
@@ -86,7 +88,7 @@ def bound_capacity(labels, n_lists: int):
     sizes = jnp.bincount(labels, length=n_lists)
     max_size = max(int(jnp.max(sizes)), 1)
     mean_size = max(labels.shape[0] / n_lists, 1.0)
-    cap_target = round_up(max(int(mean_size * 2.0), 8), 8)
+    cap_target = round_up(max(int(mean_size * factor), 8), 8)
     if max_size <= cap_target:
         return labels, None, n_lists, round_up(max_size, 8)
     new_labels, rep = split_oversized(labels, n_lists, cap_target)

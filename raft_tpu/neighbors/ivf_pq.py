@@ -63,6 +63,9 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     add_data_on_build: bool = True
     seed: int = 0
+    # capacity bound for sub-list splitting (multiple of mean list size, see
+    # _list_utils.bound_capacity)
+    split_factor: float = 2.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,6 +91,8 @@ class IvfPqIndex:
     metric: DistanceType = DistanceType.L2Expanded
     codebook_kind: str = "per_subspace"
     pq_bits: int = 8
+    # build-time capacity policy, inherited by extend()
+    split_factor: float = 2.0
 
     @property
     def n_lists(self) -> int:
@@ -120,11 +125,12 @@ class IvfPqIndex:
     def tree_flatten(self):
         children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
                     self.list_codes, self.list_ids, self.list_sizes)
-        return children, (self.metric, self.codebook_kind, self.pq_bits)
+        return children, (self.metric, self.codebook_kind, self.pq_bits, self.split_factor)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2])
+        return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2],
+                   split_factor=aux[3])
 
 
 def _default_pq_dim(d: int) -> int:
@@ -310,13 +316,15 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         metric=mt,
         codebook_kind=params.codebook_kind,
         pq_bits=params.pq_bits,
+        split_factor=params.split_factor,
     )
     if not params.add_data_on_build:
         return index
     return extend(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
 
 
-def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None) -> IvfPqIndex:
+def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None,
+           split_factor: float | None = None) -> IvfPqIndex:
     """Encode + append vectors (reference: ivf_pq::extend; encode path
     process_and_fill_codes, detail/ivf_pq_build.cuh)."""
     res = res or default_resources()
@@ -355,7 +363,8 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
     # their parent's center (+rotated center, +per-cluster codebook).
     # Residuals/codes were computed against the parent center, which
     # sub-lists share, so codes stay valid.
-    labels, rep, n_lists, capacity = bound_capacity(labels, index.n_lists)
+    sf = index.split_factor if split_factor is None else split_factor
+    labels, rep, n_lists, capacity = bound_capacity(labels, index.n_lists, sf)
     centers, centers_rot, codebooks = index.centers, index.centers_rot, index.codebooks
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
@@ -365,7 +374,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
     buf, idbuf, sizes = _fill_code_lists(codes, new_ids, labels, n_lists, capacity)
     return dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
-        list_codes=buf, list_ids=idbuf, list_sizes=sizes,
+        list_codes=buf, list_ids=idbuf, list_sizes=sizes, split_factor=sf,
     )
 
 
@@ -542,6 +551,7 @@ def save(index: IvfPqIndex, path: str) -> None:
         serialize_scalar(f, int(index.metric))
         serialize_scalar(f, index.codebook_kind)
         serialize_scalar(f, index.pq_bits)
+        serialize_scalar(f, float(index.split_factor))
         for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
                     index.list_codes, index.list_ids, index.list_sizes):
             serialize_mdspan(f, arr)
@@ -555,5 +565,7 @@ def load(path: str, res: Resources | None = None) -> IvfPqIndex:
         metric = DistanceType(deserialize_scalar(f))
         codebook_kind = deserialize_scalar(f)
         pq_bits = deserialize_scalar(f)
+        split_factor = float(deserialize_scalar(f))
         arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(7)]
-    return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits)
+    return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
+                      split_factor=split_factor)

@@ -27,7 +27,8 @@ def data():
 class TestBuild:
     def test_index_structure(self, data):
         x, _ = data
-        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0), x)
+        # split_factor high enough that no list splits: exact n_lists holds
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0, split_factor=16.0), x)
         assert idx.n_lists == 64
         assert idx.dim == 32
         assert idx.size == 5000
@@ -37,6 +38,20 @@ class TestBuild:
         # every real slot has a valid id; padding is -1
         ids = np.asarray(idx.list_ids)
         for l in range(64):
+            assert (ids[l, : sizes[l]] >= 0).all()
+            assert (ids[l, sizes[l]:] == -1).all()
+
+    def test_index_structure_default_split(self, data):
+        """Default split_factor may split hot lists into sub-lists sharing a
+        center; size bookkeeping and id/padding invariants must still hold."""
+        x, _ = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0), x)
+        assert idx.n_lists >= 64
+        assert idx.size == 5000
+        sizes = np.asarray(idx.list_sizes)
+        assert sizes.sum() == 5000
+        ids = np.asarray(idx.list_ids)
+        for l in range(idx.n_lists):
             assert (ids[l, : sizes[l]] >= 0).all()
             assert (ids[l, sizes[l]:] == -1).all()
 
