@@ -264,12 +264,16 @@ def main() -> int:
             continue
         if gt is None:
             # cache key covers everything that changes the true neighbors
+            # cache key covers the FULL dataset spec (seed/clusters/std/files
+            # all change the true neighbors), not just name and shape
+            import hashlib
+
+            spec_hash = hashlib.md5(
+                json.dumps(conf["dataset"], sort_keys=True).encode()
+            ).hexdigest()[:10]
             gt = ground_truth(
                 base, queries, k, metric,
-                out_dir / (
-                    f"gt-{conf['dataset']['name']}-{metric}-n{base.shape[0]}"
-                    f"-d{base.shape[1]}-q{len(queries)}-k{k}.npy"
-                ),
+                out_dir / f"gt-{spec_hash}-{metric}-q{len(queries)}-k{k}.npy",
             )
         for sp in entry.get("search_params", [{}]):
             sp_label = json.dumps(sp, sort_keys=True)
@@ -297,7 +301,10 @@ def main() -> int:
             print(f"[search] {name} {sp_label}: recall@{k}={rec:.4f} qps={qps:.1f}")
 
     if rows:
-        out_csv = out_dir / f"{conf['dataset']['name']}.csv"
+        # keyed by the conf file, not the dataset name: several configs share
+        # a dataset (variant/split-factor sweeps) and must not clobber the
+        # full-config results they are compared against
+        out_csv = out_dir / f"{conf_path.stem}.csv"
         with open(out_csv, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
             w.writeheader()
