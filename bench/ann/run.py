@@ -263,9 +263,9 @@ def main() -> int:
         if not args.search:
             continue
         if gt is None:
-            # cache key covers everything that changes the true neighbors
             # cache key covers the FULL dataset spec (seed/clusters/std/files
-            # all change the true neighbors), not just name and shape
+            # all change the true neighbors) plus the loaded shape, so a
+            # file regenerated in place with a different size also misses
             import hashlib
 
             spec_hash = hashlib.md5(
@@ -273,7 +273,10 @@ def main() -> int:
             ).hexdigest()[:10]
             gt = ground_truth(
                 base, queries, k, metric,
-                out_dir / f"gt-{spec_hash}-{metric}-q{len(queries)}-k{k}.npy",
+                out_dir / (
+                    f"gt-{spec_hash}-{metric}-n{base.shape[0]}-d{base.shape[1]}"
+                    f"-q{len(queries)}-k{k}.npy"
+                ),
             )
         for sp in entry.get("search_params", [{}]):
             sp_label = json.dumps(sp, sort_keys=True)

@@ -262,3 +262,16 @@ def test_forced_split_via_extend(rng):
     np.testing.assert_allclose(
         np.sort(np.asarray(dists), 1), np.sort(d2, 1)[:, :3], atol=1e-3, rtol=1e-3
     )
+
+
+def test_extend_inherits_split_policy(data):
+    """extend() must reuse the build-time split_factor (persisted on the
+    index), so a no-split build stays no-split through incremental adds."""
+    x, _ = data
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=64, seed=0, split_factor=16.0), x)
+    assert idx.n_lists == 64
+    rng = np.random.default_rng(7)
+    idx2 = ivf_flat.extend(idx, rng.random((400, 32)).astype(np.float32))
+    assert idx2.n_lists == 64  # would split under the 1.3 default
+    assert idx2.split_factor == 16.0
+    assert idx2.size == idx.size + 400
