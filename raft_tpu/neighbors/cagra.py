@@ -84,8 +84,11 @@ class SearchParams:
     # random points (search_plan.cuh random_samplings); a scored pool costs
     # one cheap matmul and keeps recall on clustered data where random
     # entries land in the wrong basin and the graph has no cross-cluster
-    # edges. 0 → plain random entries (reference behavior).
-    seed_pool: int = 4096
+    # edges. Pool size sets the entry-coverage recall ceiling at scale:
+    # measured at 1M x 128 / 2000 clusters (itopk=32), pool 4096 → 0.846
+    # recall, 16384 → 0.973 at identical QPS — the GEMM is not the hop
+    # loop's bottleneck. 0 → plain random entries (reference behavior).
+    seed_pool: int = 16384
 
 
 @jax.tree_util.register_pytree_node_class
@@ -276,7 +279,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
     static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out", "seed_pool"),
 )
 def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
-                  search_width: int, sqrt_out: bool, seed_pool: int = 4096):
+                  search_width: int, sqrt_out: bool, seed_pool: int = 16384):
     n, d = index.dataset.shape
     m = queries.shape[0]
     deg = index.graph_degree
