@@ -72,7 +72,7 @@ def split_oversized(labels, n_lists: int, cap_target: int):
     return new_labels, rep
 
 
-def bound_capacity(labels, n_lists: int, factor: float = 2.0):
+def bound_capacity(labels, n_lists: int, factor: float = 1.3):
     """Shared capacity policy for IVF fills: lists larger than ``factor`` x
     the mean split into sub-lists (see :func:`split_oversized`); otherwise
     capacity is the max size rounded to the sublane tile. Lower factors cut

@@ -64,8 +64,11 @@ class IndexParams:
     add_data_on_build: bool = True
     seed: int = 0
     # capacity bound for sub-list splitting (multiple of mean list size, see
-    # _list_utils.bound_capacity)
-    split_factor: float = 2.0
+    # _list_utils.bound_capacity). The LUT scan's one-hot contraction work
+    # scales with capacity, so tighter capacity pays even more than for
+    # ivf_flat: 1.3 measured +68% QPS (20.5k -> 34.4k at 1M, p=8) at
+    # identical recall
+    split_factor: float = 1.3
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,7 +95,7 @@ class IvfPqIndex:
     codebook_kind: str = "per_subspace"
     pq_bits: int = 8
     # build-time capacity policy, inherited by extend()
-    split_factor: float = 2.0
+    split_factor: float = 1.3
 
     @property
     def n_lists(self) -> int:
