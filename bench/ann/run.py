@@ -40,6 +40,8 @@ if os.environ.get("JAX_PLATFORMS"):
     import jax
 
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # the shared recipe lives in raft_tpu.core.platform.force_virtual_cpu;
+    # this path keeps the user's explicit platform choice instead of cpu
 
 
 def load_dataset(spec: dict):

@@ -8,23 +8,24 @@ per-namespace gtest binaries. Environment variables must be set before the
 first jax import.
 """
 
-import os
+import sys
+from pathlib import Path
 
-import re
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-_flags = os.environ.get("XLA_FLAGS", "")
-_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", _flags)
-os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+from raft_tpu.core.platform import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-# Force CPU: the ambient environment pins JAX to the single-chip TPU tunnel;
-# tests want 8 virtual devices. jax is already imported by the interpreter's
-# sitecustomize, so the env var route is too late — use the config API, which
-# works any time before backend initialization.
-jax.config.update("jax_platforms", "cpu")
+# Fail loudly if something initialized the backend before the force landed —
+# otherwise single-device tests would silently run on the ambient TPU platform.
+assert jax.default_backend() == "cpu" and len(jax.devices()) >= 8, (
+    f"platform force failed: backend={jax.default_backend()} devices={len(jax.devices())}"
+)
 
 jax.config.update("jax_enable_x64", False)
 
