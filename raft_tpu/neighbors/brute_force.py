@@ -28,6 +28,63 @@ from ..matrix.select_k import select_k
 
 __all__ = ["knn", "knn_merge_parts", "BruteForce"]
 
+# metrics the fused Pallas kernel handles natively (ops/fused_knn.py);
+# everything else stays on the XLA GEMM + top_k path
+_FUSED_L2 = {
+    DistanceType.L2Expanded: False,
+    DistanceType.L2SqrtExpanded: True,
+    DistanceType.L2Unexpanded: False,
+    DistanceType.L2SqrtUnexpanded: True,
+}
+
+
+def _fused_eligible(metric, k, n, d, mode, compute):
+    import os
+
+    from ..ops.fused_knn import FUSED_KNN_MAX_K
+
+    # the kernel is Mosaic-compiled on TPU only; elsewhere it would run in
+    # interpret-mode emulation, which is far slower than the XLA path — tests
+    # opt in explicitly via RAFT_TPU_FUSED_KNN_INTERPRET=1
+    on_tpu = jax.default_backend() == "tpu"
+    interpret_ok = os.environ.get("RAFT_TPU_FUSED_KNN_INTERPRET", "").lower() in (
+        "1", "true", "yes")
+    return (
+        (on_tpu or interpret_ok)
+        and mode == "exact"
+        and compute in ("float32", "float32x3", "bfloat16")
+        and 0 < k <= FUSED_KNN_MAX_K
+        and n >= 4096
+        and d <= 4096
+        and (metric in _FUSED_L2
+             or metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded))
+    )
+
+
+def _bf_knn_fused(dataset, queries, k, metric, compute, keep_mask):
+    """Route to the fused Pallas kernel (scores never leave VMEM)."""
+    from ..ops.fused_knn import fused_knn
+
+    mode = {"float32": "f32", "float32x3": "f32x3", "bfloat16": "bf16"}[compute]
+    interpret = jax.default_backend() != "tpu"
+    if metric in _FUSED_L2:
+        return fused_knn(dataset, queries, k, metric="l2", mode=mode,
+                         keep_mask=keep_mask, sqrt=_FUSED_L2[metric],
+                         interpret=interpret)
+    if metric == DistanceType.InnerProduct:
+        return fused_knn(dataset, queries, k, metric="ip", mode=mode,
+                         keep_mask=keep_mask, interpret=interpret)
+    # CosineExpanded: 1 - cos = 1 - ip over normalized rows (distance/pairwise
+    # _cosine uses the same normalization)
+    qn = jnp.linalg.norm(queries.astype(jnp.float32), axis=1, keepdims=True)
+    yn = jnp.linalg.norm(dataset.astype(jnp.float32), axis=1, keepdims=True)
+    sim, idx = fused_knn(dataset / jnp.maximum(yn, 1e-30),
+                         queries / jnp.maximum(qn, 1e-30), k,
+                         metric="ip", mode=mode, keep_mask=keep_mask,
+                         interpret=interpret)
+    dist = jnp.where(jnp.isinf(sim), jnp.inf, 1.0 - sim)
+    return dist, idx
+
 
 @functools.partial(
     jax.jit,
@@ -86,9 +143,15 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     :class:`~raft_tpu.neighbors.sample_filter.BitsetFilter` / boolean keep-mask
     over dataset rows. ``mode``: "exact" (sort-based TopK) or "approx"
     (TPU PartialReduce, ≥0.99 expected recall, ~2x faster). ``compute``:
-    "float32" (bit-accurate distances) or "bfloat16" (single-pass MXU
-    contraction — same neighbor ordering in all but razor-thin margins,
-    several times the GEMM throughput).
+    "float32" (bit-accurate distances), "float32x3" (compensated bf16x3
+    contraction, f32-class accuracy at ~1/3 the MXU cost; falls back to
+    "float32" when the fused kernel is not engaged) or "bfloat16"
+    (single-pass MXU contraction — same neighbor ordering in all but
+    razor-thin margins, several times the GEMM throughput).
+
+    On TPU, L2/inner-product/cosine searches with k ≤ 64 and n ≥ 4096
+    dispatch to the fused Pallas kernel (ops/fused_knn.py) — same neighbor
+    sets; within-1-ULP distance ties may order differently.
     Returns (distances (m, k), indices (m, k))."""
     from .sample_filter import resolve_filter
 
@@ -100,12 +163,17 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     n = dataset.shape[0]
     expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
     expects(mode in ("exact", "approx"), "mode must be 'exact' or 'approx', got %r", mode)
-    expects(compute in _PRECISIONS,
-            "compute must be one of %s, got %r", sorted(_PRECISIONS), compute)
+    expects(compute in _PRECISIONS or compute == "float32x3",
+            "compute must be one of %s, got %r",
+            sorted(_PRECISIONS) + ["float32x3"], compute)
     mt = resolve_metric(metric)
     keep_mask = resolve_filter(sample_filter)
     if keep_mask is not None:
         expects(keep_mask.shape == (n,), "sample filter must cover all %d dataset rows", n)
+    if _fused_eligible(mt, int(k), n, dataset.shape[1], mode, compute):
+        return _bf_knn_fused(dataset, queries, int(k), mt, compute, keep_mask)
+    if compute == "float32x3":
+        compute = "float32"  # XLA fallback has no compensated mode
     # outer tile bounds the (tile, n) score block; inner tile bounds the
     # elementwise-metric broadcast within _pairwise
     tile = _choose_tile(queries.shape[0], n, 1, res.workspace_bytes)

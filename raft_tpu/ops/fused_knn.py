@@ -1,0 +1,240 @@
+"""Fused distance + top-k selection Pallas kernel for brute-force kNN.
+
+This is the TPU resolution of SURVEY.md hard part #2 ("competitive batched
+select_k").  The reference GPU stack computes a tiled distance GEMM, writes the
+score tile to global memory, and runs a separate selection kernel over it
+(cpp/include/raft/neighbors/detail/knn_brute_force.cuh:232-273 tile+select
+loop; cpp/include/raft/matrix/detail/select_radix.cuh and
+detail/select_warpsort.cuh selection kernels).  On TPU the measured bottleneck
+of that structure is HBM traffic: the (m, n) score matrix costs one write plus
+~3 sort passes of reads, and XLA's TopK custom call cannot fuse its producer.
+
+This kernel never materializes scores to HBM.  Grid = (query_tiles,
+dataset_blocks), dataset-block minor.  Each step computes a (QT, NBLK) score
+block in VMEM with one MXU contraction (scores are oriented so *larger is
+better*: ``2 q·y - |y|^2`` for L2, ``q·y`` for inner product), then runs a
+threshold-gated iterative extraction: a block is scanned for candidates only
+while its row-maximum still beats the running k-th best (``tau``), which skips
+most extraction work in later blocks.  Running top-k state lives in VMEM
+scratch that persists across the dataset-block walk; only the final (QT, k)
+values and indices leave the chip.
+
+Measured on the 100k x 128, k=10, 10k-query batch flagship config (v5e,
+distinct-data chained batches): 217k QPS vs 145k for the XLA GEMM + lax.top_k
+pipeline in the same process, with identical neighbor sets (mode="f32").
+
+Modes:
+  "f32"   — f32 inputs, Precision.HIGHEST contraction. Exact: neighbor sets
+            match the XLA f32 pipeline; within-1-ULP distance ties may order
+            differently (score accumulation order differs between kernels).
+  "f32x3" — compensated bf16x3 contraction (hi/lo split, three MXU passes),
+            f32-class accuracy at roughly a third of the MXU cost. Neighbor
+            sets match f32 except where two distances differ by < ~1e-6 rel.
+  "bf16"  — single-pass bf16 contraction. Fastest; set recall ~0.98 on
+            worst-case (uniform) data, higher on clustered data.
+
+Ties: equal scores resolve to the lowest dataset index, matching lax.top_k.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["fused_knn", "FUSED_KNN_MAX_K"]
+
+FUSED_KNN_MAX_K = 64          # merge buffer is one 128-lane register: 2k <= 128
+_NEG = -3.0e38                # finite sentinel: 0 * _NEG must stay finite
+_BIG = 2**30                  # "no index" sentinel
+
+
+def _extract_topk_ids(v, ids, k):
+    """k iterations of (max, argmin-id, mask-by-id) over a small (QT, W) array.
+
+    Ties resolve to the smallest payload id; masking is by id, so duplicate
+    values at different ids are extracted separately.
+    """
+    vals, idxs = [], []
+    for _ in range(k):
+        m = jnp.max(v, axis=1, keepdims=True)
+        am = jnp.min(jnp.where(v >= m, ids, _BIG), axis=1, keepdims=True)
+        vals.append(m)
+        idxs.append(am)
+        v = jnp.where(ids == am, _NEG, v)
+    return jnp.concatenate(vals, axis=1), jnp.concatenate(idxs, axis=1)
+
+
+def _scores(q, y, mode):
+    """MXU contraction q @ y.T in the requested precision mode."""
+    dn = (((1,), (1,)), ((), ()))
+    if mode == "bf16":
+        return jax.lax.dot_general(
+            q.astype(jnp.bfloat16), y.astype(jnp.bfloat16), dn,
+            preferred_element_type=jnp.float32)
+    if mode == "f32x3":
+        # compensated bf16x3: x·y ~ hi·hi + hi·lo + lo·hi (Mosaic has no
+        # Precision.HIGH lowering, so the split is spelled out)
+        qh = q.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        yh = y.astype(jnp.bfloat16)
+        yl = (y - yh.astype(jnp.float32)).astype(jnp.bfloat16)
+        return (jax.lax.dot_general(qh, yh, dn, preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(qh, yl, dn, preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(ql, yh, dn, preferred_element_type=jnp.float32))
+    return jax.lax.dot_general(q, y, dn, precision=lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+
+
+def _make_kernel(k, nblk, n, qt, mode, l2, has_mask):
+    def kernel(q_ref, y_ref, yn_ref, *rest):
+        if has_mask:
+            keep_ref = rest[0]
+            rest = rest[1:]
+        out_v_ref, out_i_ref, run_v, run_i, s_ref, cand_v, cand_i, go_ref = rest
+
+        j = pl.program_id(1)
+        nb = pl.num_programs(1)
+
+        @pl.when(j == 0)
+        def _init():
+            run_v[:] = jnp.full((qt, 128), _NEG, jnp.float32)
+            run_i[:] = jnp.full((qt, 128), _BIG, jnp.int32)
+
+        s = _scores(q_ref[:], y_ref[:], mode)
+        if l2:
+            s = 2.0 * s - yn_ref[:]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (qt, nblk), 1) + j * nblk
+        s = jnp.where(cols < n, s, _NEG)
+        if has_mask:
+            s = jnp.where(keep_ref[:] > 0.0, s, _NEG)
+        s_ref[:] = s
+
+        tau = run_v[:, k - 1:k]
+        go_ref[0] = 1
+        cand_v[:] = jnp.full((qt, 128), _NEG, jnp.float32)
+        cand_i[:] = jnp.full((qt, 128), _BIG, jnp.int32)
+
+        for t in range(k):                      # static unroll, flag-gated
+            @pl.when(go_ref[0] == 1)
+            def _step(t=t):
+                sv = s_ref[:]
+                m = jnp.max(sv, axis=1, keepdims=True)
+                any_improve = jnp.any(m > tau)
+                go_ref[0] = any_improve.astype(jnp.int32)
+
+                @pl.when(any_improve)
+                def _extract():
+                    am = jnp.min(jnp.where(sv >= m, cols, _BIG), axis=1,
+                                 keepdims=True)
+                    cand_v[:, t] = m[:, 0]
+                    cand_i[:, t] = am[:, 0]
+                    s_ref[:] = jnp.where(cols == am, _NEG, sv)
+
+        mv = jnp.concatenate([run_v[:, :k], cand_v[:, :k]], axis=1)
+        mi = jnp.concatenate([run_i[:, :k], cand_i[:, :k]], axis=1)
+        nv, ni = _extract_topk_ids(mv, mi, k)
+        run_v[:, :k] = nv
+        run_i[:, :k] = ni
+
+        @pl.when(j == nb - 1)
+        def _emit():
+            out_v_ref[:] = run_v[:, :k]
+            out_i_ref[:] = run_i[:, :k]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "l2", "mode", "qt", "nblk", "interpret"))
+def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
+                    interpret):
+    n, d = dataset.shape
+    m = queries.shape[0]
+    n_pad = -(-n // nblk) * nblk
+    m_pad = -(-m // qt) * qt
+    d_pad = -(-d // 128) * 128
+    ds = jnp.pad(dataset, ((0, n_pad - n), (0, d_pad - d)))
+    qs = jnp.pad(queries, ((0, m_pad - m), (0, d_pad - d)))
+    ynp = (jnp.pad(yn, (0, n_pad - n)).reshape(1, n_pad)
+           if yn is not None else jnp.zeros((1, n_pad), jnp.float32))
+    grid = (m_pad // qt, n_pad // nblk)
+    has_mask = keep is not None
+    kern = _make_kernel(k, nblk, n, qt, mode, l2, has_mask)
+
+    in_specs = [
+        pl.BlockSpec((qt, d_pad), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((nblk, d_pad), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, nblk), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+    ]
+    args = [qs, ds, ynp]
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec((1, nblk), lambda i, j: (0, j), memory_space=pltpu.VMEM))
+        args.append(jnp.pad(keep.astype(jnp.float32), (0, n_pad - n)).reshape(1, n_pad))
+
+    out_v, out_i = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((qt, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((qt, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, k), jnp.float32),
+            jax.ShapeDtypeStruct((m_pad, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qt, 128), jnp.float32),     # running top-k values
+            pltpu.VMEM((qt, 128), jnp.int32),       # running top-k ids
+            pltpu.VMEM((qt, nblk), jnp.float32),    # score block
+            pltpu.VMEM((qt, 128), jnp.float32),     # block candidates (values)
+            pltpu.VMEM((qt, 128), jnp.int32),       # block candidates (ids)
+            pltpu.SMEM((1,), jnp.int32),            # extraction gate
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)
+    return out_v[:m], out_i[:m]
+
+
+def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
+              sqrt=False, qt=256, nblk=4096, interpret=False):
+    """Exact brute-force kNN via the fused Pallas kernel.
+
+    ``metric``: "l2" (squared euclidean; ``sqrt=True`` for euclidean) or
+    "ip" (inner product; larger = closer, like the reference's
+    DistanceType::InnerProduct contract).  Cosine is "ip" over pre-normalized
+    inputs (the caller normalizes, as distance/pairwise._cosine does).
+
+    Returns (distances (m, k) f32, indices (m, k) int32).  Rows with fewer
+    than k admissible dataset points (under ``keep_mask``) get -1 indices and
+    +inf distances in the unfilled slots, matching brute_force.knn.
+    """
+    n, d = dataset.shape
+    l2 = metric == "l2"
+    yn = (jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1) if l2 else None)
+    # shrink the dataset block if the feature dim would blow the VMEM budget
+    while nblk > 512 and (qt + nblk) * max(d, 128) * 4 + qt * nblk * 4 > 24 * 2**20:
+        nblk //= 2
+    out_v, out_i = _fused_knn_impl(dataset, queries, yn, keep_mask, int(k),
+                                   l2, mode, qt, nblk, interpret)
+    empty = out_v <= _NEG / 2
+    out_i = jnp.where(empty, -1, out_i)
+    if l2:
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        dist = jnp.maximum(qn - out_v, 0.0)
+        if sqrt:
+            dist = jnp.sqrt(dist)
+        dist = jnp.where(empty, jnp.inf, dist)
+    else:
+        dist = out_v                                  # similarity, larger=closer
+        dist = jnp.where(empty, -jnp.inf, dist)
+    return dist, out_i
