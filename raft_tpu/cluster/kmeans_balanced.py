@@ -81,7 +81,10 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         # candidate points re-weighted by their cluster's crowdedness: same
         # bias, (k, pool) work.
         pool = min(max(4 * k, 4096), n)
-        pool_idx = jax.random.randint(kp, (pool,), 0, n)
+        # without replacement: duplicate pool entries would let two small
+        # clusters re-seed to the same point, the starvation the Gumbel
+        # top-k below exists to prevent
+        pool_idx = jax.random.choice(kp, n, (pool,), replace=False)
         pool_w = counts[labels[pool_idx]]  # crowdedness of each candidate
         logits = jnp.log(jnp.maximum(pool_w, 1e-6))
         # Gumbel top-k = weighted sampling WITHOUT replacement: k distinct

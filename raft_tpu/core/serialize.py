@@ -25,7 +25,41 @@ __all__ = [
     "deserialize_scalar",
     "serialize_json",
     "deserialize_json",
+    "serialize_header",
+    "check_header",
+    "SERIALIZATION_VERSION",
 ]
+
+# Index-file format version, bumped whenever an index serializer changes its
+# stream layout (the reference writes and checks serialization_version for the
+# same reason — ivf_flat_serialize.cuh:37,135). A string (not an int) so that
+# pre-versioning streams — whose next scalar was a small int — fail the check
+# with a clear message instead of being misread.
+#   raft_tpu/2: version header added; ivf_flat/ivf_pq carry split_factor.
+SERIALIZATION_VERSION = "raft_tpu/2"
+
+
+def serialize_header(fp: BinaryIO, tag: str) -> None:
+    """Write the index-file header: type tag + format version."""
+    serialize_scalar(fp, tag)
+    serialize_scalar(fp, SERIALIZATION_VERSION)
+
+
+def check_header(fp: BinaryIO, tag: str) -> None:
+    """Read and validate the header, failing with actionable messages."""
+    from .errors import expects
+
+    got = deserialize_scalar(fp)
+    article = "an" if tag[:1] in "aeiou" else "a"
+    expects(got == tag, "not %s %s index file (tag=%r)", article, tag, got)
+    ver = deserialize_scalar(fp)
+    expects(
+        ver == SERIALIZATION_VERSION,
+        "unsupported %s index file format %r (this build reads %r) — the file "
+        "was written by an incompatible raft_tpu version; rebuild and re-save "
+        "the index",
+        tag, ver, SERIALIZATION_VERSION,
+    )
 
 
 def serialize_mdspan(fp: BinaryIO, arr) -> None:

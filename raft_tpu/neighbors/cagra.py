@@ -36,7 +36,8 @@ from jax import lax
 
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
-from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
+from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
+                              serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.types import DistanceType, resolve_metric
 from . import ivf_pq as ivf_pq_mod
 from .refine import refine
@@ -66,7 +67,9 @@ class IndexParams:
     # refine pool costs far less than pq8's 10x-slower LUT scan
     refine_rate: float = 3.0
     # query rows per device dispatch during the self-search/refine phases —
-    # keeps any single device program under watchdog/VMEM pressure limits
+    # keeps any single device program under watchdog/VMEM pressure limits.
+    # Honored down to 1 (lower = more, smaller dispatches; useful when VMEM
+    # limits bite at high d); values below ~1024 cost dispatch overhead
     build_chunk: int = 16384
     seed: int = 0
 
@@ -147,7 +150,7 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     # here too — cagra_build.cuh:86 loops over max_batch_size query blocks),
     # k+1 then drop self
     sp = ivf_pq_mod.SearchParams(n_probes=params.build_n_probes)
-    chunk = max(int(params.build_chunk), 1024)
+    chunk = max(int(params.build_chunk), 1)
     parts = []
     for s in range(0, n, chunk):
         xb = x[s:s + chunk]
@@ -379,6 +382,12 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
 
 
 @auto_convert_output
+def resolve_max_iterations(params: SearchParams) -> int:
+    """Default hop budget (reference: adjust_search_params, cagra_search.cuh)."""
+    return params.max_iterations or (
+        params.itopk_size // max(params.search_width, 1) + 10)
+
+
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
     cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD)."""
@@ -387,7 +396,7 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
     expects(k <= params.itopk_size, "k must be <= itopk_size (ref cagra_types.hpp:66)")
     itopk = params.itopk_size
-    max_iter = params.max_iterations or (itopk // max(params.search_width, 1) + 10)
+    max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
     return _cagra_search(index, queries, int(k), int(itopk), int(max_iter),
                          int(params.search_width), sqrt_out, int(params.seed_pool))
@@ -396,7 +405,7 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
 def save(index: CagraIndex, path: str) -> None:
     """Serialize (reference: cagra_serialize.cuh)."""
     with open(path, "wb") as f:
-        serialize_scalar(f, "cagra")
+        serialize_header(f, "cagra")
         serialize_scalar(f, int(index.metric))
         serialize_mdspan(f, index.dataset)
         serialize_mdspan(f, index.graph)
@@ -404,8 +413,7 @@ def save(index: CagraIndex, path: str) -> None:
 
 def load(path: str, res: Resources | None = None) -> CagraIndex:
     with open(path, "rb") as f:
-        tag = deserialize_scalar(f)
-        expects(tag == "cagra", "not a cagra index file (tag=%s)", tag)
+        check_header(f, "cagra")
         metric = DistanceType(deserialize_scalar(f))
         dataset = jnp.asarray(deserialize_mdspan(f))
         graph = jnp.asarray(deserialize_mdspan(f))

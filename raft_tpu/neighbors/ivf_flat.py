@@ -35,7 +35,8 @@ from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
-from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
+from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
+                              serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
@@ -360,7 +361,7 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
 def save(index: IvfFlatIndex, path: str) -> None:
     """Serialize (reference: ivf_flat_serialize.cuh; pylibraft save)."""
     with open(path, "wb") as f:
-        serialize_scalar(f, "ivf_flat")
+        serialize_header(f, "ivf_flat")
         serialize_scalar(f, int(index.metric))
         serialize_scalar(f, float(index.split_factor))
         serialize_mdspan(f, index.centers)
@@ -373,8 +374,7 @@ def save(index: IvfFlatIndex, path: str) -> None:
 def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
     """Deserialize (reference: ivf_flat_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        tag = deserialize_scalar(f)
-        expects(tag == "ivf_flat", "not an ivf_flat index file (tag=%s)", tag)
+        check_header(f, "ivf_flat")
         metric = DistanceType(deserialize_scalar(f))
         split_factor = float(deserialize_scalar(f))
         centers = jnp.asarray(deserialize_mdspan(f))

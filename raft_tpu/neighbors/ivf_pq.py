@@ -38,7 +38,8 @@ from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
-from ..core.serialize import deserialize_mdspan, deserialize_scalar, serialize_mdspan, serialize_scalar
+from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
+                              serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
@@ -550,7 +551,7 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
 def save(index: IvfPqIndex, path: str) -> None:
     """Serialize (reference: ivf_pq_serialize.cuh:52-110)."""
     with open(path, "wb") as f:
-        serialize_scalar(f, "ivf_pq")
+        serialize_header(f, "ivf_pq")
         serialize_scalar(f, int(index.metric))
         serialize_scalar(f, index.codebook_kind)
         serialize_scalar(f, index.pq_bits)
@@ -563,8 +564,7 @@ def save(index: IvfPqIndex, path: str) -> None:
 def load(path: str, res: Resources | None = None) -> IvfPqIndex:
     """Deserialize (reference: ivf_pq_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        tag = deserialize_scalar(f)
-        expects(tag == "ivf_pq", "not an ivf_pq index file (tag=%s)", tag)
+        check_header(f, "ivf_pq")
         metric = DistanceType(deserialize_scalar(f))
         codebook_kind = deserialize_scalar(f)
         pq_bits = deserialize_scalar(f)

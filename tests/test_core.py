@@ -117,3 +117,35 @@ def test_operators_vocabulary(rng):
     assert int(r.key) == 2 and float(r.value) == 0.25
     r = ops.argmax_op(a, b)
     assert int(r.key) == 1 and float(r.value) == 0.5
+
+
+class TestSerializationVersion:
+    """Format-version header (reference: serialization_version checks,
+    ivf_flat_serialize.cuh:37,135)."""
+
+    def test_old_unversioned_stream_fails_clearly(self, tmp_path):
+        # a pre-versioning stream: tag followed directly by the metric int
+        from raft_tpu.core import RaftError, serialize_scalar
+        from raft_tpu.neighbors import ivf_flat
+
+        path = str(tmp_path / "old.bin")
+        with open(path, "wb") as f:
+            serialize_scalar(f, "ivf_flat")
+            serialize_scalar(f, 1)          # old layout: metric enum here
+        with pytest.raises(RaftError, match="unsupported ivf_flat index file format"):
+            ivf_flat.load(path)
+
+    def test_version_roundtrip_all_indexes(self, tmp_path, rng):
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
+
+        x = jnp.asarray(rng.random((256, 16), "float32"))
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x)
+        p = str(tmp_path / "a.bin")
+        ivf_flat.save(idx, p)
+        assert ivf_flat.load(p).metric == idx.metric
+
+        pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, seed=0), x)
+        p2 = str(tmp_path / "b.bin")
+        ivf_pq.save(pq, p2)
+        assert ivf_pq.load(p2).pq_bits == pq.pq_bits
