@@ -3,9 +3,9 @@
 The reference ships the communicator and leaves distributed algorithms to
 consumers (cuML/cuGraph over raft::comms, docs/source/using_comms.rst); here
 the canonical ones are in-tree: sharded exact kNN with global merge, multi-chip k-means, and
-list-sharded IVF-Flat search.
+list-sharded IVF-Flat/IVF-PQ search, and per-shard CAGRA with ICI merge.
 """
 
-from . import ivf, kmeans, knn
+from . import cagra, ivf, kmeans, knn
 
-__all__ = ["knn", "kmeans", "ivf"]
+__all__ = ["knn", "kmeans", "ivf", "cagra"]

@@ -1,0 +1,131 @@
+"""Multi-chip CAGRA: one graph index per dataset shard, beam searches run
+shard-local, candidates merge over ICI.
+
+A CAGRA graph cannot be row-sharded naively — pruned edges cross arbitrary
+rows, so a beam on one chip would constantly dereference vectors living on
+another. The multi-GPU pattern the reference ecosystem uses instead (per-GPU
+indexes over dataset partitions, query fan-out, heap merge — the raft::comms +
+knn_merge_parts composition of docs/source/using_comms.rst and
+detail/knn_merge_parts.cuh) maps cleanly to SPMD: each shard owns an
+independent CAGRA graph over its rows (builds are embarrassingly parallel —
+on a real pod every host builds its own shard), searches are replicated
+queries against every shard's graph inside one shard_map, and a single
+all_gather + select_k produces the global top-k. Recall of the merged result
+is at least the per-shard recall: every shard contributes its own true local
+top-k candidates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comms.comms import Comms, replicated, shard_along
+from ..core.errors import expects
+from ..distance.types import DistanceType
+from ..matrix.select_k import _select_k
+from ..neighbors.cagra import (CagraIndex, IndexParams, SearchParams, _cagra_search,
+                               resolve_max_iterations)
+from ..neighbors.cagra import build as build_single
+
+__all__ = ["ShardedCagraIndex", "build", "search"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ShardedCagraIndex:
+    """Stacked per-shard CAGRA indexes: shard s owns dataset rows
+    [s*rows_per_shard, (s+1)*rows_per_shard) of the original ordering."""
+
+    dataset: jax.Array   # (S, n/S, d)
+    graph: jax.Array     # (S, n/S, graph_degree) int32, shard-local ids
+    metric: DistanceType = DistanceType.L2Expanded
+
+    @property
+    def n_shards(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def rows_per_shard(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[2]
+
+    def tree_flatten(self):
+        return (self.dataset, self.graph), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0])
+
+
+def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
+    """Build one CAGRA graph per shard (host loop; on a multi-host pod each
+    host builds only its own shard — the graphs are fully independent)."""
+    dataset = jnp.asarray(dataset)
+    n = dataset.shape[0]
+    size = comms.size()
+    expects(n % size == 0, "dataset rows (%d) must divide the mesh axis (%d); pad first",
+            n, size)
+    rows = n // size
+    expects(params.graph_degree < rows, "graph_degree must be < rows per shard (%d)", rows)
+    shards = [build_single(params, dataset[s * rows:(s + 1) * rows])
+              for s in range(size)]
+    return ShardedCagraIndex(
+        dataset=jnp.stack([s.dataset for s in shards]),
+        graph=jnp.stack([s.graph for s in shards]),
+        metric=shards[0].metric,
+    )
+
+
+def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
+           queries, k: int):
+    """Distributed CAGRA search: per-shard beam search + ICI merge.
+
+    Returns replicated (distances (m, k), global ids (m, k)); ids refer to
+    the original (pre-sharding) dataset row ordering.
+    """
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
+    expects(k <= params.itopk_size, "k must be <= itopk_size")
+    size = comms.size()
+    expects(index.n_shards == size, "index has %d shards but mesh axis is %d",
+            index.n_shards, size)
+    rows = index.rows_per_shard
+    itopk = params.itopk_size
+    max_iter = resolve_max_iterations(params)
+    sqrt_out = index.metric in (DistanceType.L2SqrtExpanded,
+                                DistanceType.L2SqrtUnexpanded)
+    seed_pool = int(params.seed_pool)  # _cagra_search clamps to shard rows
+    inner = index.metric == DistanceType.InnerProduct
+
+    def step(data, graph, q):
+        shard = CagraIndex(dataset=data[0], graph=graph[0], metric=index.metric)
+        d_loc, i_loc = _cagra_search(shard, q, k, itopk, max_iter,
+                                     int(params.search_width), sqrt_out, seed_pool)
+        i_glob = jnp.where(i_loc >= 0,
+                           i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
+        d_all = comms.allgather(d_loc)
+        i_all = comms.allgather(i_glob)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, not inner)
+
+    mesh, axis = comms.mesh, comms.axis
+    args = (
+        shard_along(mesh, axis, index.dataset),
+        shard_along(mesh, axis, index.graph),
+        replicated(mesh, queries),
+    )
+    fn = comms.shard_map(
+        step,
+        in_specs=(P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(fn)(*args)

@@ -24,7 +24,7 @@ from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
 from ..neighbors.ivf_flat import IvfFlatIndex, SearchParams, _ivf_search
 
-__all__ = ["search"]
+__all__ = ["search", "search_pq"]
 
 
 def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
@@ -112,6 +112,114 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
     fn = comms.shard_map(
         step,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(fn)(*args)
+
+
+def _pad_pq_lists(index, size: int):
+    """Pad an IvfPqIndex with empty lists so n_lists divides the mesh axis
+    (same trick as _pad_lists_to_multiple: far-away centers rank last in the
+    L2 coarse scoring; padded lists are size-0 so their slots can never win)."""
+    from ..neighbors.ivf_pq import IvfPqIndex
+
+    L = index.n_lists
+    pad = (-L) % size
+    if pad == 0:
+        return index
+    expects(
+        index.metric != DistanceType.InnerProduct,
+        "inner-product distributed search needs n_lists (%d) divisible by the "
+        "mesh axis (%d) — rebuild with a different n_lists",
+        L, size,
+    )
+    cap = index.capacity
+    pq_dim = index.list_codes.shape[-1]
+    far = 1e15
+    codebooks = index.codebooks
+    if index.codebook_kind == "per_cluster":
+        codebooks = jnp.concatenate(
+            [codebooks, jnp.zeros((pad,) + codebooks.shape[1:], codebooks.dtype)])
+    return IvfPqIndex(
+        centers=jnp.concatenate(
+            [index.centers, jnp.full((pad, index.dim), far, index.centers.dtype)]),
+        centers_rot=jnp.concatenate(
+            [index.centers_rot,
+             jnp.full((pad, index.centers_rot.shape[1]), far, index.centers_rot.dtype)]),
+        rotation=index.rotation,
+        codebooks=codebooks,
+        list_codes=jnp.concatenate(
+            [index.list_codes, jnp.zeros((pad, cap, pq_dim), index.list_codes.dtype)]),
+        list_ids=jnp.concatenate(
+            [index.list_ids, jnp.full((pad, cap), -1, jnp.int32)]),
+        list_sizes=jnp.concatenate(
+            [index.list_sizes, jnp.zeros((pad,), jnp.int32)]),
+        metric=index.metric,
+        codebook_kind=index.codebook_kind,
+        pq_bits=index.pq_bits,
+        split_factor=index.split_factor,
+    )
+
+
+def search_pq(comms: Comms, params, index, queries, k: int):
+    """Distributed IVF-PQ search: lists sharded over the mesh axis, local LUT
+    scans, one all_gather + select_k merge (the same composition as IVF-Flat
+    ``search`` above; reference pattern: per-shard indexes + knn_merge_parts,
+    docs/source/using_comms.rst + detail/knn_merge_parts.cuh).
+
+    ``params`` is :class:`raft_tpu.neighbors.ivf_pq.SearchParams`. Distances
+    are PQ-approximate, like the single-chip search; run
+    :func:`raft_tpu.neighbors.refine` against the (locally stored) dataset
+    shard to sharpen candidates — the PQ index itself carries no raw vectors.
+
+    Returns replicated (distances (m, k), global ids (m, k)).
+    """
+    from ..neighbors.ivf_pq import IvfPqIndex, _pq_search
+
+    queries = jnp.asarray(queries)
+    size = comms.size()
+    index = _pad_pq_lists(index, size)
+    L = index.n_lists
+    lists_per_shard = L // size
+    n_probes = min(params.n_probes, lists_per_shard)
+    expects(0 < k <= n_probes * index.capacity, "k exceeds per-shard candidate pool")
+    inner = index.metric == DistanceType.InnerProduct
+    per_cluster = index.codebook_kind == "per_cluster"
+    lut_bf16 = params.lut_dtype == "bfloat16"
+
+    def step(centers, centers_rot, codebooks, codes, ids, sizes, q):
+        shard = IvfPqIndex(
+            centers, centers_rot, index.rotation, codebooks, codes, ids, sizes,
+            metric=index.metric, codebook_kind=index.codebook_kind,
+            pq_bits=index.pq_bits, split_factor=index.split_factor)
+        d_loc, i_loc = _pq_search(
+            shard, q, n_probes, k,
+            query_tile=min(128, q.shape[0]), probe_chunk=n_probes,
+            metric=index.metric, codebook_kind=index.codebook_kind,
+            lut_bf16=lut_bf16)
+        d_all = comms.allgather(d_loc)
+        i_all = comms.allgather(i_loc)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, not inner)
+
+    mesh, axis = comms.mesh, comms.axis
+    cb_spec = P(axis) if per_cluster else P()
+    cb_arg = (shard_along(mesh, axis, index.codebooks) if per_cluster
+              else replicated(mesh, index.codebooks))
+    args = (
+        shard_along(mesh, axis, index.centers),
+        shard_along(mesh, axis, index.centers_rot),
+        cb_arg,
+        shard_along(mesh, axis, index.list_codes),
+        shard_along(mesh, axis, index.list_ids),
+        shard_along(mesh, axis, index.list_sizes),
+        replicated(mesh, queries),
+    )
+    fn = comms.shard_map(
+        step,
+        in_specs=(P(axis), P(axis), cb_spec, P(axis), P(axis), P(axis), P()),
         out_specs=(P(), P()),
     )
     return jax.jit(fn)(*args)
