@@ -1,74 +1,195 @@
 """raft_tpu benchmark entry point (run by the driver on real TPU hardware).
 
-Prints ONE JSON line: the flagship metric is exact-kNN search throughput
-(QPS) on a synthetic 100k x 128 dataset, k=10 — the brute-force operating
-point of the reference's ANN harness (cpp/bench/ann, batch-mode QPS metric,
-cpp/bench/ann/src/common/benchmark.hpp:168). The reference publishes no
-numbers (BASELINE.md), so vs_baseline is reported as 1.0 by definition of
-"no published baseline"; cross-framework comparison happens via the recorded
-absolute QPS.
+Prints ONE JSON line. The primary metric stays the exact brute-force kNN
+search throughput on 100k x 128, k=10, batch 10k (the protocol BENCH_r01
+recorded, so rounds are comparable), now served by the fused Pallas
+distance+top-k kernel (ops/fused_knn.py). A "rows" field carries the
+regression suite the driver archives per round: exact kNN plus IVF-Flat and
+CAGRA at 1M with QPS and recall@10, mirroring the reference harness's
+(recall, QPS) operating points (cpp/bench/ann/src/common/benchmark.hpp:111-200).
 
 Measurement notes:
-- batches are chained inside ONE jitted program (lax.map over distinct query
-  batches) and the result is materialized to host — the device tunnel in this
-  environment caches repeated identical dispatches and under-reports blocking
-  waits, so naive per-call timing with block_until_ready reports fantasy QPS;
-- every batch has distinct query data; reported QPS divides total queries by
-  total wall time including the final host sync.
+- batches are chained inside ONE jitted program with DISTINCT query data and
+  materialized to host: the device tunnel caches repeated identical dispatches
+  and under-reports blocking waits, so anything else reports fantasy QPS;
+- all data is generated on-device (jax.random) — a 512 MB host->device
+  transfer through the tunnel would dominate the timings;
+- 1M rows build cold-jit in-process (~2-6 min total); rows degrade gracefully:
+  if a row fails or the soft time budget is exceeded, remaining rows are
+  reported as skipped rather than failing the whole bench.
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
+SOFT_BUDGET_S = 300.0  # stop starting new rows beyond this
+_T0 = time.perf_counter()
 
-def main():
+
+def _elapsed():
+    return time.perf_counter() - _T0
+
+
+def _note(msg):
+    print(f"[bench +{_elapsed():.0f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _recall(ids, gt):
+    import numpy as np
+
+    ids, gt = np.asarray(ids), np.asarray(gt)
+    k = gt.shape[1]
+    return float(np.mean([len(set(ids[r, :k]) & set(gt[r])) / k
+                          for r in range(gt.shape[0])]))
+
+
+def _measure_qps(search_fn, query_sets, m):
+    """Best-of-N wall time for one jitted search over distinct query sets."""
+    import jax
+    import numpy as np
+
+    jax.block_until_ready(query_sets)
+    f = jax.jit(search_fn)
+    np.asarray(jax.tree_util.tree_leaves(f(query_sets[0]))[0])  # compile+warm
+    best = float("inf")
+    out = None
+    for qs in query_sets[1:]:
+        t0 = time.perf_counter()
+        out = f(qs)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return m / best, out
+
+
+def _flagship_exact(rows):
+    """Exact kNN 100k x 128 — identical protocol to BENCH_r01."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax import lax
 
-    from raft_tpu.neighbors.brute_force import _bf_knn
+    from raft_tpu.neighbors.brute_force import _bf_knn_fused
     from raft_tpu.distance.types import DistanceType
 
     n, d, m, k = 100_000, 128, 10_000, 10
     n_batches = 10
-    rng = np.random.default_rng(0)
-    dataset = jnp.asarray(rng.random((n, d), np.float32))
-    batches = jnp.asarray(rng.random((n_batches, m, d), np.float32))
+    key = jax.random.key(0)
+    kd, *kq = jax.random.split(key, 5)
+    dataset = jax.random.uniform(kd, (n, d), jnp.float32)
 
-    def one_batch(q):
-        return _bf_knn(dataset, q, k, DistanceType.L2Expanded, 2.0, 1000, 1000)
+    def one_set(kk):
+        return jax.random.uniform(kk, (n_batches, m, d), jnp.float32)
 
-    chained = jax.jit(lambda qs: jax.lax.map(one_batch, qs))
+    def searches(qs):
+        return lax.map(lambda q: _bf_knn_fused(
+            dataset, q, k, DistanceType.L2Expanded, "float32", None), qs)
 
-    # warmup / compile (distinct data so nothing is reusable)
-    warm = jnp.asarray(rng.random((n_batches, m, d), np.float32))
-    np.asarray(jax.tree_util.tree_leaves(chained(warm))[0])
+    qps, _ = _measure_qps(searches, [one_set(kk) for kk in kq],
+                          n_batches * m)
+    rows.append({"name": "exact_fused_knn_100k", "qps": round(qps, 1),
+                 "recall": 1.0, "build_s": 0.0})
+    return qps
 
-    # best of 3: tunnel RPC latency and transient device contention add
-    # tens-of-percent run-to-run noise; min is the standard de-noiser
-    batch_sets = [batches] + [
-        jnp.asarray(rng.random((n_batches, m, d), np.float32)) for _ in range(2)
-    ]
-    dt = float("inf")
-    for bs in batch_sets:
-        t0 = time.perf_counter()
-        out = chained(bs)
-        np.asarray(jax.tree_util.tree_leaves(out)[0])  # host materialization
-        dt = min(dt, time.perf_counter() - t0)
 
-    qps = n_batches * m / dt
-    print(
-        json.dumps(
-            {
-                "metric": "exact brute-force kNN QPS (100k x 128 f32, k=10, batch 10k)",
-                "value": round(qps, 1),
-                "unit": "QPS",
-                "vs_baseline": 1.0,
-            }
-        )
-    )
+def _make_1m():
+    """Clustered synthetic 1M x 128 + 10k queries, generated on-device
+    (same distribution as bench/ann/run.py load_dataset: 2000 blobs)."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d, m, ncl = 1_000_000, 128, 10_000, 2000
+    kc, kl, kn, kq1, kq2, kq3 = jax.random.split(jax.random.key(42), 6)
+    centers = jax.random.uniform(kc, (ncl, d), jnp.float32) * 10.0
+
+    def draw(kk_lab, kk_noise, count):
+        labels = jax.random.randint(kk_lab, (count,), 0, ncl)
+        return centers[labels] + 0.5 * jax.random.normal(kk_noise, (count, d))
+
+    dataset = draw(kl, kn, n)
+    qsets = []
+    for kk in (kq1, kq2, kq3):
+        ka, kb = jax.random.split(kk)
+        qsets.append(draw(ka, kb, m))
+    return dataset, qsets
+
+
+def main():
+    import jax
+    import numpy as np
+
+    rows = []
+    _note("flagship exact 100k")
+    primary_qps = _flagship_exact(rows)
+
+    gt = None
+    try:
+        if _elapsed() < SOFT_BUDGET_S:
+            _note("generating 1M dataset")
+            dataset, qsets = _make_1m()
+            jax.block_until_ready([dataset] + qsets)
+
+            # ground truth for recall on the first 1000 queries of set 0
+            from raft_tpu.neighbors.brute_force import _bf_knn_fused
+            from raft_tpu.distance.types import DistanceType
+            _note("ground truth 1k queries")
+            # _measure_qps returns the output for the LAST query set — ground
+            # truth must cover those same queries
+            gt_q = qsets[-1][:1000]
+            _, gt = _bf_knn_fused(dataset, gt_q, 10,
+                                  DistanceType.L2Expanded, "float32", None)
+            gt = np.asarray(gt)
+    except Exception as e:  # pragma: no cover - bench resilience
+        rows.append({"name": "dataset_1m", "error": str(e)[:200]})
+
+    if gt is not None and _elapsed() < SOFT_BUDGET_S:
+        try:
+            from raft_tpu.neighbors import ivf_flat
+
+            _note("ivf_flat build")
+            t0 = time.perf_counter()
+            idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, seed=0), dataset)
+            jax.block_until_ready(idx.list_data)
+            build_s = time.perf_counter() - t0
+            sp = ivf_flat.SearchParams(n_probes=8)
+            qps, out = _measure_qps(
+                lambda q: ivf_flat.search(sp, idx, q, 10), qsets, qsets[0].shape[0])
+            rows.append({"name": "ivf_flat_1m_p8",
+                         "qps": round(qps, 1),
+                         "recall": round(_recall(np.asarray(out[1])[:1000], gt), 4),
+                         "build_s": round(build_s, 1)})
+        except Exception as e:  # pragma: no cover
+            rows.append({"name": "ivf_flat_1m_p8", "error": str(e)[:200]})
+
+    if gt is not None and _elapsed() < SOFT_BUDGET_S:
+        try:
+            from raft_tpu.neighbors import cagra
+
+            _note("cagra build")
+            t0 = time.perf_counter()
+            idx = cagra.build(cagra.IndexParams(), dataset)
+            jax.block_until_ready(idx.graph)
+            build_s = time.perf_counter() - t0
+            sp = cagra.SearchParams(itopk_size=32)
+            qps, out = _measure_qps(
+                lambda q: cagra.search(sp, idx, q, 10), qsets, qsets[0].shape[0])
+            rows.append({"name": "cagra_1m_itopk32",
+                         "qps": round(qps, 1),
+                         "recall": round(_recall(np.asarray(out[1])[:1000], gt), 4),
+                         "build_s": round(build_s, 1)})
+        except Exception as e:  # pragma: no cover
+            rows.append({"name": "cagra_1m_itopk32", "error": str(e)[:200]})
+
+    print(json.dumps({
+        "metric": "exact brute-force kNN QPS (100k x 128 f32, k=10, batch 10k)",
+        "value": round(primary_qps, 1),
+        "unit": "QPS",
+        "vs_baseline": 1.0,
+        "rows": rows,
+        "elapsed_s": round(_elapsed(), 1),
+    }))
 
 
 if __name__ == "__main__":

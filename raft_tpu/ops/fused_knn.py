@@ -218,7 +218,12 @@ def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
     than k admissible dataset points (under ``keep_mask``) get -1 indices and
     +inf distances in the unfilled slots, matching brute_force.knn.
     """
+    from ..core.errors import expects
+
     n, d = dataset.shape
+    expects(0 < k <= FUSED_KNN_MAX_K,
+            "fused_knn supports k in (0, %d], got %d — use brute_force.knn "
+            "for larger k", FUSED_KNN_MAX_K, k)
     l2 = metric == "l2"
     yn = (jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1) if l2 else None)
     # shrink the dataset block if the feature dim would blow the VMEM budget
