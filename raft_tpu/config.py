@@ -1,4 +1,4 @@
-"""Global output-type configuration.
+"""Global configuration: output types + the persistent compilation cache.
 
 Re-design of pylibraft.config (python/pylibraft/pylibraft/config.py:15-46):
 ``set_output_as`` installs a global conversion applied by
@@ -17,9 +17,41 @@ from typing import Any, Callable
 
 import jax
 
-__all__ = ["set_output_as", "get_output_as", "auto_convert_output"]
+__all__ = ["set_output_as", "get_output_as", "auto_convert_output",
+           "enable_compilation_cache"]
 
 _output_as: str | Callable = "jax"
+
+
+def enable_compilation_cache(path: str | None = None) -> str:
+    """Persist XLA compilations across processes (the warm-build story).
+
+    1M-scale index builds are dominated by cold-jit compilation (IVF-Flat
+    ~120 s, CAGRA ~320 s cold vs seconds warm — BASELINE.md); the reference
+    avoids this class of cost with ahead-of-time compiled kernels in libraft
+    (SURVEY.md R1/R2 explicit instantiations). The TPU analogue is JAX's
+    persistent compilation cache: with it enabled, a second process rebuilding
+    or re-searching the same shapes skips compilation entirely. Combine with
+    ``neighbors.*.save``/``load`` so repeat users pay neither compile nor
+    build cost.
+
+    Returns the cache directory in effect (default
+    ``~/.cache/raft_tpu/jit``).
+    """
+    import os
+
+    import jax
+
+    path = path or os.path.join(
+        os.path.expanduser("~"), ".cache", "raft_tpu", "jit")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every entry, however small/fast — index pipelines are many
+    # medium-sized programs, and the defaults skip anything that compiles
+    # in under a second
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    return path
 
 
 def set_output_as(output: str | Callable) -> None:

@@ -105,7 +105,14 @@ class IvfFlatIndex:
 
     @property
     def size(self) -> int:
-        return int(jnp.sum(self.list_sizes))
+        """Total stored vectors. Computed on host so it stays concrete even
+        when an enclosing jit trace is active (e.g. a user wrapping search()
+        in jax.jit captures the index as a closure constant — staging the sum
+        would make int() fail on a tracer). Unavailable when the index itself
+        is a traced jit argument."""
+        import numpy as np
+
+        return int(np.asarray(jax.device_get(self.list_sizes)).sum())
 
     def tree_flatten(self):
         return (
@@ -333,7 +340,9 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
-    expects(index.capacity > 0 and index.size > 0, "index is empty")
+    expects(index.capacity > 0, "index is empty")
+    if not isinstance(index.list_sizes, jax.core.Tracer):
+        expects(index.size > 0, "index is empty")
     n_probes = min(params.n_probes, index.n_lists)
     m = queries.shape[0]
     expects(

@@ -54,3 +54,27 @@ def res():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Apply the slow marker from tests/slow_tests.txt (measured durations on
+    the CPU mesh — see pytest.ini). The fast tier is `pytest -m "not slow"`."""
+    from pathlib import Path
+
+    listed = {
+        line.strip()
+        for line in (Path(__file__).parent / "slow_tests.txt").read_text().splitlines()
+        if line.strip() and not line.startswith("#")
+    }
+    collected = {item.nodeid for item in items}
+    stale = listed - collected
+    if stale and not config.option.keyword and not config.option.markexpr:
+        import warnings
+
+        warnings.warn(
+            f"slow_tests.txt lists {len(stale)} nodeid(s) that no longer exist "
+            f"(renamed tests silently join the fast tier): {sorted(stale)[:5]}",
+            stacklevel=1)
+    for item in items:
+        if item.nodeid in listed:
+            item.add_marker(pytest.mark.slow)

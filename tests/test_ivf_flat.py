@@ -275,3 +275,36 @@ def test_extend_inherits_split_policy(data):
     assert idx2.n_lists == 64  # would split under the 1.3 default
     assert idx2.split_factor == 16.0
     assert idx2.size == idx.size + 400
+
+
+def test_search_inside_enclosing_jit(rng):
+    """Users may wrap search() in their own jax.jit (the bench does); the
+    index is then a closure constant and host-side int() properties must not
+    stage into the trace."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.asarray(rng.random((600, 8)).astype(np.float32))
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x)
+    q = x[:5]
+    d0, i0 = ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx, q, 3)
+    d1, i1 = jax.jit(
+        lambda qq: ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx, qq, 3))(q)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    # index as a traced jit ARGUMENT (pytree-flattened): exercises the
+    # Tracer-guard branch that skips the data-dependent emptiness check
+    d2, i2 = jax.jit(
+        lambda ix, qq: ivf_flat.search(ivf_flat.SearchParams(n_probes=4), ix, qq, 3))(idx, q)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i2))
+
+    # same contract for ivf_pq
+    from raft_tpu.neighbors import ivf_pq
+
+    pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=4, seed=0), x)
+    p0 = ivf_pq.search(ivf_pq.SearchParams(n_probes=4), pq, q, 3)
+    p1 = jax.jit(
+        lambda qq: ivf_pq.search(ivf_pq.SearchParams(n_probes=4), pq, qq, 3))(q)
+    p2 = jax.jit(
+        lambda ix, qq: ivf_pq.search(ivf_pq.SearchParams(n_probes=4), ix, qq, 3))(pq, q)
+    np.testing.assert_array_equal(np.asarray(p0[1]), np.asarray(p1[1]))
+    np.testing.assert_array_equal(np.asarray(p0[1]), np.asarray(p2[1]))
