@@ -46,13 +46,19 @@ def _recall(ids, gt):
                           for r in range(gt.shape[0])]))
 
 
-def _measure_qps(search_fn, query_sets, m):
-    """Best-of-N wall time for one jitted search over distinct query sets."""
+def _measure_qps(search_fn, query_sets, m, use_jit=True):
+    """Best-of-N wall time over distinct query sets, host-materialized.
+
+    ``use_jit=False`` for index searches: they carry their own internal jit
+    caches, and an enclosing jit would re-trace the whole 1M-scale pipeline
+    into one giant program (minutes of extra compile for no steady-state
+    gain).
+    """
     import jax
     import numpy as np
 
     jax.block_until_ready(query_sets)
-    f = jax.jit(search_fn)
+    f = jax.jit(search_fn) if use_jit else search_fn
     np.asarray(jax.tree_util.tree_leaves(f(query_sets[0]))[0])  # compile+warm
     best = float("inf")
     out = None
@@ -155,7 +161,8 @@ def main():
             build_s = time.perf_counter() - t0
             sp = ivf_flat.SearchParams(n_probes=8)
             qps, out = _measure_qps(
-                lambda q: ivf_flat.search(sp, idx, q, 10), qsets, qsets[0].shape[0])
+                lambda q: ivf_flat.search(sp, idx, q, 10), qsets,
+                qsets[0].shape[0], use_jit=False)
             rows.append({"name": "ivf_flat_1m_p8",
                          "qps": round(qps, 1),
                          "recall": round(_recall(np.asarray(out[1])[:1000], gt), 4),
@@ -174,7 +181,8 @@ def main():
             build_s = time.perf_counter() - t0
             sp = cagra.SearchParams(itopk_size=32)
             qps, out = _measure_qps(
-                lambda q: cagra.search(sp, idx, q, 10), qsets, qsets[0].shape[0])
+                lambda q: cagra.search(sp, idx, q, 10), qsets,
+                qsets[0].shape[0], use_jit=False)
             rows.append({"name": "cagra_1m_itopk32",
                          "qps": round(qps, 1),
                          "recall": round(_recall(np.asarray(out[1])[:1000], gt), 4),

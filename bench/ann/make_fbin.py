@@ -1,0 +1,72 @@
+"""Generate a disk-backed big-ANN dataset (.fbin) through the native runtime.
+
+The reference's ANN harness is built around on-disk datasets
+(cpp/bench/ann/conf/sift-128-euclidean.json; bigann .fbin/.u8bin formats,
+docs/source/cuda_ann_benchmarks.md). This environment has no network, so the
+equivalent end-to-end IO path is: generate the clustered-synthetic
+distribution once, persist it as .fbin via the native writer
+(cpp/runtime.cpp write_bin), and point a conf's ``base_file``/``query_file``
+at it — the harness then reads it back through the pread-based chunked
+loader like any downloaded bigann file.
+
+  python bench/ann/make_fbin.py --out /tmp/ann-data --n 1000000 --dim 128
+  python bench/ann/run.py --conf bench/ann/conf/fbin-1M-128.json --build --search
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--n-queries", type=int, default=10_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--clusters", type=int, default=2000)
+    ap.add_argument("--cluster-std", type=float, default=0.5)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from raft_tpu.runtime import write_bin
+
+    out = pathlib.Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(args.seed)
+    centers = (rng.random((args.clusters, args.dim), np.float32) * 10).astype(np.float32)
+
+    def draw(count):
+        labels = rng.integers(0, args.clusters, count)
+        return (centers[labels]
+                + rng.normal(0, args.cluster_std, (count, args.dim))).astype(np.float32)
+
+    base_path = out / f"base-{args.n}x{args.dim}.fbin"
+    query_path = out / f"query-{args.n_queries}x{args.dim}.fbin"
+    # write in chunks so peak host memory stays bounded at big-ANN scale
+    chunk = 200_000
+    first = draw(min(chunk, args.n))
+    write_bin(str(base_path), first)
+    written = first.shape[0]
+    if written < args.n:
+        with open(base_path, "r+b") as f:
+            # fix the header once to the final row count, then stream chunks
+            np.array([args.n, args.dim], np.uint32).tofile(f)
+            f.seek(8 + written * args.dim * 4)
+            while written < args.n:
+                block = draw(min(chunk, args.n - written))
+                block.tofile(f)
+                written += block.shape[0]
+    write_bin(str(query_path), draw(args.n_queries))
+    print(f"wrote {base_path} ({args.n}x{args.dim}) and {query_path}")
+
+
+if __name__ == "__main__":
+    main()

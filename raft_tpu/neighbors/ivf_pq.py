@@ -56,8 +56,16 @@ class IndexParams:
 
     n_lists: int = 1024
     metric: str | DistanceType = "sqeuclidean"
-    pq_bits: int = 8  # codebook size = 2**pq_bits (ref :68, 4..8 supported)
-    pq_dim: int = 0  # 0 → d/2 rounded to a multiple of 8 (ref :81 heuristic)
+    # codebook size = 2**pq_bits; 4..8 supported. DEFAULT DIFFERS FROM THE
+    # REFERENCE (ivf_pq_types.hpp:68 defaults 8): the TPU LUT scan is a
+    # one-hot MXU contraction whose axis is pq_dim * 2**pq_bits, so pq8
+    # costs ~16x pq4 at equal code bytes (measured at 1M x 128: pq4x64
+    # 41.4k QPS vs pq8x32 2.6k at the same recall point; int8/bf16 LUTs do
+    # not close it). The reference's smem-gather LUT is bits-insensitive,
+    # which does NOT hold here — prefer pq_bits=4 with doubled pq_dim. See
+    # docs/migrating_from_raft.md.
+    pq_bits: int = 4
+    pq_dim: int = 0  # 0 → auto: same code bytes as the reference default (d/2 at 8 bits, d at 4)
     codebook_kind: str = "per_subspace"  # ref :43 codebook_gen
     force_random_rotation: bool = False  # ref :98
     kmeans_n_iters: int = 20
@@ -77,7 +85,11 @@ class SearchParams:
     """Reference: ivf_pq::search_params (ivf_pq_types.hpp:108-140)."""
 
     n_probes: int = 20
-    lut_dtype: str = "float32"  # "float32" | "bfloat16" (ref lut_dtype :122)
+    # "float32" | "bfloat16" | "int8" (ref lut_dtype, ivf_pq_types.hpp:122 —
+    # the fp8-class smem LUT maps to bf16/int8 here; int8 quantizes per
+    # (query, probe) with a symmetric scale and accumulates in int32 on the
+    # MXU's int8 path, halving LUT operand bytes again vs bf16)
+    lut_dtype: str = "float32"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -144,12 +156,16 @@ class IvfPqIndex:
                    split_factor=aux[3])
 
 
-def _default_pq_dim(d: int) -> int:
-    """Reference heuristic (ivf_pq_types.hpp:81): ~d/2, a multiple of 8."""
-    pq = max(d // 2, 1)
+def _default_pq_dim(d: int, pq_bits: int = 4) -> int:
+    """Bits-aware variant of the reference heuristic (ivf_pq_types.hpp:81,
+    ~d/2 at its default 8 bits): the auto pq_dim keeps CODE BYTES equal to
+    the reference default — d/2 dims at 8 bits and d dims at 4 bits are both
+    d/2 bytes per vector, so switching the TPU-preferred pq_bits=4 default
+    does not silently halve quantization budget."""
+    pq = max((d * 8) // (2 * pq_bits), 1)
     if pq >= 8:
         pq = (pq // 8) * 8
-    return pq
+    return min(pq, d)
 
 
 def _make_rotation(key, d_rot: int, d: int, force_random: bool):
@@ -261,7 +277,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     expects(params.codebook_kind in ("per_subspace", "per_cluster"),
             "codebook_kind must be per_subspace|per_cluster")
 
-    pq_dim = params.pq_dim or _default_pq_dim(d)
+    pq_dim = params.pq_dim or _default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
     d_rot = pq_dim * pq_len
     n_codes = 1 << params.pq_bits
@@ -392,10 +408,10 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
 @functools.partial(
     jax.jit,
     static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric",
-                     "codebook_kind", "lut_bf16"),
+                     "codebook_kind", "lut_dtype"),
 )
 def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: int,
-               probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_bf16: bool,
+               probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_dtype: str,
                keep_mask=None):
     m, d = queries.shape
     qf = queries.astype(jnp.float32)
@@ -473,17 +489,35 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
             oh = (
                 codes[..., None] == jnp.arange(n_codes, dtype=codes.dtype)
             )  # (T, pc, cap, pq_dim, n_codes)
-            # the contraction dtype follows lut_dtype (0/1 one-hot entries are
-            # exact in either; bf16 rounds LUT values to ~2^-8 relative but
-            # fuses tighter and halves operand bytes); f32 accumulation always
-            ct = jnp.bfloat16 if lut_bf16 else jnp.float32
+            # the contraction dtype follows lut_dtype (0/1 one-hot entries
+            # are exact in any of them):
+            #   float32  — exact LUT values
+            #   bfloat16 — LUT rounded to ~2^-8 relative, half the bytes
+            #   int8     — LUT quantized per (query, probe) with a symmetric
+            #              scale (the reference's fp8 smem LUT analogue,
+            #              detail/fp_8bit.cuh); int32 accumulation on the
+            #              int8 MXU path, quarter the operand bytes
             ohf = oh.reshape(query_tile, probe_chunk, cap, pq_dim * n_codes)
             lutf = lut.reshape(query_tile, probe_chunk, pq_dim * n_codes)
-            scores = lax.dot_general(
-                ohf.astype(ct), lutf.astype(ct),
-                (((3,), (2,)), ((0, 1), (0, 1))),
-                preferred_element_type=jnp.float32,
-            )  # (T, pc, cap)
+            if lut_dtype not in ("float32", "bfloat16", "int8"):
+                raise ValueError(f"unknown lut_dtype {lut_dtype!r}")
+            if lut_dtype == "int8":
+                amax = jnp.max(jnp.abs(lutf), axis=2, keepdims=True)  # (T,pc,1)
+                scale = jnp.maximum(amax, 1e-30) / 127.0
+                lut_q = jnp.clip(jnp.round(lutf / scale), -127, 127).astype(jnp.int8)
+                acc = lax.dot_general(
+                    ohf.astype(jnp.int8), lut_q,
+                    (((3,), (2,)), ((0, 1), (0, 1))),
+                    preferred_element_type=jnp.int32,
+                )  # (T, pc, cap) int32
+                scores = acc.astype(jnp.float32) * scale
+            else:
+                ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+                scores = lax.dot_general(
+                    ohf.astype(ct), lutf.astype(ct),
+                    (((3,), (2,)), ((0, 1), (0, 1))),
+                    preferred_element_type=jnp.float32,
+                )  # (T, pc, cap)
             scores = scores + bias[:, :, None]
             scores = jnp.where(ids >= 0, scores, -jnp.inf if inner else jnp.inf)
             if keep_mask is not None:
@@ -530,8 +564,9 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     expects(k <= n_probes * index.capacity, "k exceeds probed candidate pool")
     m = queries.shape[0]
 
-    expects(params.lut_dtype in ("float32", "bfloat16"),
-            "lut_dtype must be 'float32' or 'bfloat16', got %r", params.lut_dtype)
+    expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
+            "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
+            params.lut_dtype)
     # chunk memory model: codes gather (uint8) + gathered LUT values (f32) +
     # scores (f32) per capacity slot, plus the LUT itself; x2 for XLA
     # temporaries (the gather and its consumer co-exist) — undercounting here
@@ -552,7 +587,7 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
         validate_filter_covers(index, keep_mask)
     return _pq_search(
         index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
-        index.codebook_kind, params.lut_dtype == "bfloat16",
+        index.codebook_kind, params.lut_dtype,
         keep_mask,
     )
 

@@ -185,7 +185,9 @@ def search_pq(comms: Comms, params, index, queries, k: int):
     expects(0 < k <= n_probes * index.capacity, "k exceeds per-shard candidate pool")
     inner = index.metric == DistanceType.InnerProduct
     per_cluster = index.codebook_kind == "per_cluster"
-    lut_bf16 = params.lut_dtype == "bfloat16"
+    expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
+            "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
+            params.lut_dtype)
 
     def step(centers, centers_rot, codebooks, codes, ids, sizes, q):
         shard = IvfPqIndex(
@@ -196,7 +198,7 @@ def search_pq(comms: Comms, params, index, queries, k: int):
             shard, q, n_probes, k,
             query_tile=min(128, q.shape[0]), probe_chunk=n_probes,
             metric=index.metric, codebook_kind=index.codebook_kind,
-            lut_bf16=lut_bf16)
+            lut_dtype=params.lut_dtype)
         d_all = comms.allgather(d_loc)
         i_all = comms.allgather(i_loc)
         m = q.shape[0]

@@ -35,7 +35,7 @@ class TestBuild:
         assert idx.pq_dim == 8
         assert idx.pq_len == 4  # 32 / 8
         assert idx.size == 6000
-        assert idx.codebooks.shape == (8, 256, 4)
+        assert idx.codebooks.shape == (8, 16, 4)  # 2**pq_bits=16 (TPU default 4)
 
     def test_pq_bits(self, data):
         x, _ = data
@@ -46,7 +46,9 @@ class TestBuild:
     def test_default_pq_dim(self, data):
         x, _ = data
         idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, seed=0), x)
-        assert idx.pq_dim == 16  # d/2 = 16
+        # bits-aware heuristic: equal code bytes to the reference default
+        # (d/2 dims at 8 bits == d dims at 4 bits == d/2 bytes)
+        assert idx.pq_dim == 32  # d at the pq_bits=4 default
 
     def test_rotation_is_orthonormal(self, data):
         x, _ = data
@@ -66,7 +68,7 @@ class TestBuild:
 class TestSearch:
     def test_recall_all_probes(self, data):
         x, q = data
-        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16, seed=0), x)
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=32, seed=0), x)
         _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), idx, q, k=10)
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
         rec = _recall(np.asarray(i), true_i)
@@ -74,7 +76,7 @@ class TestSearch:
 
     def test_recall_grows_with_probes(self, data):
         x, q = data
-        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=64, pq_dim=16, seed=0), x)
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=64, pq_dim=32, seed=0), x)
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
         recalls = [
             _recall(np.asarray(ivf_pq.search(ivf_pq.SearchParams(n_probes=p), idx, q, 10)[1]), true_i)
@@ -87,7 +89,7 @@ class TestSearch:
         """The reference pipeline: ivf_pq search k0 > k → exact refine → k
         (pylibraft ivf_pq+refine pattern, CAGRA build dependency)."""
         x, q = data
-        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=16, seed=0), x)
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=32, seed=0), x)
         _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), idx, q, k=40)
         d, i = refine(x, q, np.asarray(cand), k=10)
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
@@ -97,7 +99,7 @@ class TestSearch:
     def test_per_cluster_codebooks(self, data):
         x, q = data
         idx = ivf_pq.build(
-            ivf_pq.IndexParams(n_lists=16, pq_dim=8, codebook_kind="per_cluster", seed=0), x
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, codebook_kind="per_cluster", seed=0), x
         )
         # one codebook per list (sub-lists share their parent's codebook)
         assert idx.codebooks.shape[0] == idx.n_lists >= 16
@@ -111,7 +113,7 @@ class TestSearch:
     def test_inner_product(self, data):
         x, q = data
         idx = ivf_pq.build(
-            ivf_pq.IndexParams(n_lists=32, pq_dim=16, metric="inner_product", seed=0), x
+            ivf_pq.IndexParams(n_lists=32, pq_dim=32, metric="inner_product", seed=0), x
         )
         _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), idx, q, k=10)
         true_i = np.argsort(-(q @ x.T), 1)[:, :10]
@@ -163,3 +165,27 @@ class TestSerialize:
         d2, i2 = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), idx2, q, k=5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_int8_lut(rng):
+    """int8 LUT (the reference's fp8 smem-LUT analogue, detail/fp_8bit.cuh):
+    per-(query,probe) symmetric quantization must track the f32 LUT ranking
+    closely at full probe coverage."""
+    import jax.numpy as jnp
+    from scipy.spatial import distance as sp_dist
+
+    x = rng.random((3000, 32)).astype(np.float32)
+    q = rng.random((20, 32)).astype(np.float32)
+    idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0), jnp.asarray(x))
+    d32, i32 = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, q, 10)
+    d8, i8 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=16, lut_dtype="int8"), idx, q, 10)
+    i32, i8 = np.asarray(i32), np.asarray(i8)
+    overlap = np.mean([len(set(i32[r]) & set(i8[r])) / 10 for r in range(20)])
+    assert overlap > 0.8, overlap
+    # both should be decent vs exact ground truth
+    gt = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), axis=1)[:, :10]
+    rec8 = np.mean([len(set(i8[r]) & set(gt[r])) / 10 for r in range(20)])
+    rec32 = np.mean([len(set(i32[r]) & set(gt[r])) / 10 for r in range(20)])
+    assert rec8 > rec32 - 0.1, (rec8, rec32)

@@ -91,3 +91,31 @@ def test_merge_parts_host(rng):
 def test_native_available_or_fallback():
     # informational: record which path the suite exercised
     assert runtime.available() in (True, False)
+
+
+def test_make_fbin_roundtrip(tmp_path):
+    """bench/ann/make_fbin.py writes chunked big-ANN files the native loader
+    reads back intact (the no-network stand-in for downloading SIFT-1M)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [sys.executable, str(repo / "bench/ann/make_fbin.py"), "--out",
+         str(tmp_path), "--n", "300000", "--n-queries", "50", "--dim", "16",
+         "--clusters", "10"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    from raft_tpu.runtime import bin_info, load_bin, read_bin_chunk
+
+    base = str(tmp_path / "base-300000x16.fbin")
+    assert bin_info(base) == (300000, 16)
+    rows = read_bin_chunk(base, 299_990, 10)
+    assert rows.shape == (10, 16)
+    q = load_bin(str(tmp_path / "query-50x16.fbin"))
+    assert q.shape == (50, 16)
+    # chunk boundary continuity: rows on either side of the 200k chunk edge
+    a = read_bin_chunk(base, 199_999, 2)
+    assert np.isfinite(a).all() and a.std() > 0
