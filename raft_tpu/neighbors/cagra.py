@@ -134,7 +134,10 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     gpu_top_k = min(int(k * params.refine_rate), n - 1)
 
     n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
-    pq_bits = params.build_pq_bits or (4 if ivf_pq_mod._default_pq_dim(d) >= 32 else 8)
+    # threshold evaluated against the reference-equivalent ~d/2 heuristic
+    # (pq_bits=8 arg) so the bits-aware default change in _default_pq_dim
+    # does not shift this auto decision (pq4 from d >= 64, as documented)
+    pq_bits = params.build_pq_bits or (4 if ivf_pq_mod._default_pq_dim(d, 8) >= 32 else 8)
     pq = ivf_pq_mod.build(
         ivf_pq_mod.IndexParams(
             n_lists=min(n_lists, n // 4 if n >= 32 else n),

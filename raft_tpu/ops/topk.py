@@ -1,24 +1,27 @@
 """Pallas streaming top-k with index payloads.
 
-The critical selection kernel called out in SURVEY.md §2.3 (P8): the
-reference implements two CUDA selectors (11-bit radix filter,
+The selection kernel called out in SURVEY.md §2.3 (P8): the reference
+implements two CUDA selectors (11-bit radix filter,
 matrix/detail/select_radix.cuh, and warp bitonic queues,
-detail/select_warpsort.cuh) because a full sort is wasteful for k ≪ n. XLA's
-TopK on TPU is sort-based; for the ANN stack's k ≤ ~64 a streaming selector
-wins: score columns arrive in VMEM blocks (Pallas pipelines the HBM reads),
-and a running sorted top-k per row lives in VMEM scratch. Each block is
-merged by k iterations of (min, argmin, mask) on the VPU — O(k·(k+B)) per
-block instead of a sort network over n.
+detail/select_warpsort.cuh) because a full sort is wasteful for k ≪ n; XLA's
+TopK custom call on TPU is sort-based and costs ~3 HBM passes over the
+matrix.
 
-Exact (bit-identical values to lax.top_k for select_min; ties may resolve to
-a different but equally-minimal index).
+This kernel streams the matrix once: column blocks arrive in VMEM (Pallas
+pipelines the HBM reads) and a running top-k per row lives in VMEM scratch.
+Selection is *threshold-gated* iterative extraction — a block is scanned only
+while its row-maximum still beats the running k-th best (``tau``), so most
+blocks beyond the first few cost one max-pass over VMEM. The same structure
+fused with the distance GEMM is ops/fused_knn.py; this variant is the
+standalone selector for matrices that already exist in HBM, dispatched from
+matrix/select_k.py for wide rows on TPU.
 
-Measured on TPU v5 lite (100k cols, k=10): this kernel does NOT beat XLA —
-the k-iteration argmax/mask loop re-reads each block ~4k times on the VPU
-(66-138 ms/batch vs 56 ms for lax.top_k and 24 ms for lax.approx_min_k), so
-the library's hot paths keep lax.top_k (exact) / approx_min_k (fast). The
-kernel stays as the starting point for a future single-pass threshold-filter
-variant and as the reference Pallas selector for k > XLA's TopK sweet spot.
+An earlier ungated VPU design (k-iteration argmax/mask run unconditionally
+per block) measured 66-138 ms vs 56 ms for lax.top_k on (10k, 100k); the
+gated form beats lax.top_k at wide shapes (see matrix/select_k.py dispatch
+notes for measurements).
+
+Exact values; ties resolve to the lowest column index, matching lax.top_k.
 """
 
 from __future__ import annotations
@@ -32,103 +35,121 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["topk_pallas", "TOPK_MAX_K"]
 
-TOPK_MAX_K = 128
-_NEG = -jnp.inf
+TOPK_MAX_K = 64          # merge buffer is one 128-lane register: 2k <= 128
+_NEG = -3.0e38
+_BIG = 2**30
 
 
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
+def _extract_topk_ids(v, ids, k):
+    """k iterations of (max, argmin-id, mask-by-id) over a small array."""
+    vals, idxs = [], []
+    for _ in range(k):
+        m = jnp.max(v, axis=1, keepdims=True)
+        am = jnp.min(jnp.where(v >= m, ids, _BIG), axis=1, keepdims=True)
+        vals.append(m)
+        idxs.append(am)
+        v = jnp.where(ids == am, _NEG, v)
+    return jnp.concatenate(vals, axis=1), jnp.concatenate(idxs, axis=1)
 
 
-def _topk_kernel(x_ref, out_v_ref, out_i_ref, run_v, run_i, *, k: int, blk: int, n: int):
-    """Grid dim 0 walks column blocks; scratch carries the running top-k."""
-    j = pl.program_id(0)
-    nblk = pl.num_programs(0)
-    t = x_ref.shape[0]
+def _select_kernel(x_ref, out_i_ref, run_v, run_i, s_ref,
+                   cand_v, cand_i, go_ref, *, k, blk, n, qt, select_min):
+    j = pl.program_id(1)
+    nb = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
-        run_v[:] = jnp.full((t, k), _NEG, jnp.float32)
-        run_i[:] = jnp.full((t, k), -1, jnp.int32)
+        run_v[:] = jnp.full((qt, 128), _NEG, jnp.float32)
+        run_i[:] = jnp.full((qt, 128), _BIG, jnp.int32)
 
-    block = x_ref[:].astype(jnp.float32)  # (T, BLK)
-    # mask out-of-range padding columns of the final block
-    col = jax.lax.broadcasted_iota(jnp.int32, (t, blk), 1) + j * blk
-    block = jnp.where(col < n, block, _NEG)
+    s = x_ref[:].astype(jnp.float32)
+    if select_min:
+        s = -s
+    # clamp into the sentinel-safe range so +/-inf inputs still rank above the
+    # padding sentinel (exact values are restored by a final gather from x)
+    s = jnp.clip(s, -2.9e38, 2.9e38)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (qt, blk), 1) + j * blk
+    s = jnp.where(cols < n, s, _NEG)
+    s_ref[:] = s
 
-    vals = jnp.concatenate([run_v[:], block], axis=1)  # (T, k+BLK)
-    idxs = jnp.concatenate([run_i[:], col], axis=1)
+    tau = run_v[:, k - 1:k]
+    go_ref[0] = 1
+    cand_v[:] = jnp.full((qt, 128), _NEG, jnp.float32)
+    cand_i[:] = jnp.full((qt, 128), _BIG, jnp.int32)
 
-    kcol = jax.lax.broadcasted_iota(jnp.int32, (t, k), 1)
+    for t in range(k):                           # static unroll, flag-gated
+        @pl.when(go_ref[0] == 1)
+        def _step(t=t):
+            sv = s_ref[:]
+            m = jnp.max(sv, axis=1, keepdims=True)
+            any_improve = jnp.any(m > tau)
+            go_ref[0] = any_improve.astype(jnp.int32)
 
-    def extract(i, carry):
-        vals, idxs, top_v, top_i = carry
-        am = jnp.argmax(vals, axis=1)  # (T,)
-        onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1) == am[:, None]
-        )
-        v = jnp.max(vals, axis=1)
-        gi = jnp.max(jnp.where(onehot, idxs, -1), axis=1)
-        # masked write of column i (dynamic_update_slice is not lowered on TPU)
-        top_v = jnp.where(kcol == i, v[:, None], top_v)
-        top_i = jnp.where(kcol == i, gi[:, None], top_i)
-        vals = jnp.where(onehot, _NEG, vals)
-        return vals, idxs, top_v, top_i
+            @pl.when(any_improve)
+            def _extract():
+                am = jnp.min(jnp.where(sv >= m, cols, _BIG), axis=1,
+                             keepdims=True)
+                cand_v[:, t] = m[:, 0]
+                cand_i[:, t] = am[:, 0]
+                s_ref[:] = jnp.where(cols == am, _NEG, sv)
 
-    init = (
-        vals,
-        idxs,
-        jnp.full((t, k), _NEG, jnp.float32),
-        jnp.full((t, k), -1, jnp.int32),
-    )
-    _, _, top_v, top_i = jax.lax.fori_loop(0, k, extract, init)
-    run_v[:] = top_v
-    run_i[:] = top_i
+    mv = jnp.concatenate([run_v[:, :k], cand_v[:, :k]], axis=1)
+    mi = jnp.concatenate([run_i[:, :k], cand_i[:, :k]], axis=1)
+    nv, ni = _extract_topk_ids(mv, mi, k)
+    run_v[:, :k] = nv
+    run_i[:, :k] = ni
 
-    @pl.when(j == nblk - 1)
+    @pl.when(j == nb - 1)
     def _emit():
-        out_v_ref[:] = run_v[:]
-        out_i_ref[:] = run_i[:]
+        out_i_ref[:] = run_i[:, :k]
 
 
-@functools.partial(jax.jit, static_argnames=("k", "select_min", "blk", "interpret"))
-def topk_pallas(x, k: int, select_min: bool = True, blk: int = 2048,
-                interpret: bool | None = None):
+@functools.partial(jax.jit,
+                   static_argnames=("k", "select_min", "blk", "qt", "interpret"))
+def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
+                qt: int = 256, interpret: bool | None = None):
     """Top-k of each row of ``x`` (2-D) with source-column payloads.
 
     Returns (values (m, k), indices (m, k) int32), values sorted best-first.
-    Exact; `select_min=True` mirrors lax.top_k on -x. ``interpret`` defaults
-    to True off-TPU (Pallas interpreter) so the kernel is testable on the CPU
-    mesh.
+    Exact; ``select_min=True`` mirrors lax.top_k on -x. ``interpret``
+    defaults to True off-TPU (Pallas interpreter) so the kernel is testable
+    on the CPU mesh. k <= TOPK_MAX_K; larger k belongs to lax.top_k (the
+    matrix/select_k.py dispatch handles that split).
     """
     m, n = x.shape
     if k > min(TOPK_MAX_K, n):
         raise ValueError(f"k={k} must be <= min({TOPK_MAX_K}, n={n})")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    xw = -x if select_min else x
-    blk = min(blk, _round_up(n, 128))
-    npad = _round_up(n, blk)
-    if npad != n:
-        xw = jnp.pad(xw, ((0, 0), (0, npad - n)), constant_values=_NEG)
-
-    grid = (npad // blk,)
-    out_v, out_i = pl.pallas_call(
-        functools.partial(_topk_kernel, k=k, blk=blk, n=n),
-        out_shape=(
-            jax.ShapeDtypeStruct((m, k), jnp.float32),
-            jax.ShapeDtypeStruct((m, k), jnp.int32),
-        ),
+    blk = max(128, min(blk, -(-n // 128) * 128))
+    # no host-side jnp.pad (it would copy the whole matrix through HBM):
+    # Pallas pads boundary blocks itself and the kernel masks cols >= n;
+    # boundary-row garbage is sliced away below
+    n_blocks = -(-n // blk)
+    m_blocks = -(-m // qt)
+    grid = (m_blocks, n_blocks)
+    kern = functools.partial(_select_kernel, k=k, blk=blk, n=n, qt=qt,
+                             select_min=bool(select_min))
+    out_i = pl.pallas_call(
+        kern,
         grid=grid,
-        in_specs=[pl.BlockSpec((m, blk), lambda j: (0, j), memory_space=pltpu.VMEM)],
-        out_specs=(
-            pl.BlockSpec((m, k), lambda j: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((m, k), lambda j: (0, 0), memory_space=pltpu.VMEM),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((m, k), jnp.float32),
-            pltpu.VMEM((m, k), jnp.int32),
+        in_specs=[
+            pl.BlockSpec((qt, blk), lambda i, j: (i, j), memory_space=pltpu.VMEM),
         ],
+        out_specs=pl.BlockSpec((qt, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_blocks * qt, k), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((qt, 128), jnp.float32),     # running top-k values
+            pltpu.VMEM((qt, 128), jnp.int32),       # running top-k ids
+            pltpu.VMEM((qt, blk), jnp.float32),     # block scratch
+            pltpu.VMEM((qt, 128), jnp.float32),     # block candidates
+            pltpu.VMEM((qt, 128), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),            # extraction gate
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(xw)
-    return (-out_v if select_min else out_v), out_i
+    )(x)
+    pos = jnp.minimum(out_i[:m], n - 1)        # _BIG only when a row is degenerate
+    vals = jnp.take_along_axis(x, pos, axis=1)  # exact values, infs included
+    return vals, pos

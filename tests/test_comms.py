@@ -7,6 +7,7 @@ on-device assertions mirror comms/detail/test.hpp) — per SURVEY.md §4 the
 """
 
 import numpy as np
+from jax.sharding import PartitionSpec as P
 import pytest
 from scipy.spatial import distance as sp_dist
 
@@ -227,3 +228,56 @@ class TestDistributedCagra:
         # global ids must be consistent with reported distances
         got_d = np.take_along_axis(full, i, 1)
         np.testing.assert_allclose(got_d, d, rtol=1e-3, atol=1e-3)
+
+
+class TestDocumentedEdgeSemantics:
+    """Pin the comms veneer's documented TPU trade-offs (comms.py inline
+    docs): reduce() ignores root (value lands everywhere), gather() returns
+    full copies on every shard, PROD handles zeros/signs exactly, alltoall
+    requires divisibility. The reference's rooted semantics are a host-side
+    concern on ICI; these tests make the divergence explicit."""
+
+    def test_reduce_lands_on_all_ranks(self, comms):
+        def f(x):
+            return comms.reduce(x, root=2, op="sum")
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(x)
+        # every shard (not just root=2) holds the full sum
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+    def test_gather_returns_full_copies_everywhere(self, comms):
+        def f(x):
+            return comms.gather(x, root=0).reshape(8, 1)  # (8 shards, 1) gathered
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        out = comms.shard_map(f, in_specs=P("data"), out_specs=P(None, "data"))(x)
+        got = np.asarray(out)  # (8, 8): column s is shard s's gathered copy
+        assert got.shape == (8, 8)
+        for s in range(8):
+            np.testing.assert_allclose(got[:, s], np.arange(8, dtype=np.float32))
+
+    def test_prod_with_zero_and_signs(self, comms):
+        vals = np.array([2.0, -1.0, 3.0, -2.0, 1.0, 1.0, -1.0, 2.0], np.float32)
+        def f(x):
+            return comms.allreduce(x, op="prod")
+        out = comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(
+            vals.reshape(8, 1))
+        np.testing.assert_allclose(np.asarray(out), np.full((8, 1), np.prod(vals)),
+                                   rtol=1e-5)
+        with_zero = vals.copy(); with_zero[3] = 0.0
+        out = comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(
+            with_zero.reshape(8, 1))
+        np.testing.assert_allclose(np.asarray(out), np.zeros((8, 1)))
+
+    def test_alltoall_semantics_and_divisibility(self, comms):
+        def f(x):
+            return comms.alltoall(x)
+        x = np.arange(8 * 8, dtype=np.float32).reshape(64, 1)
+        out = np.asarray(
+            comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(x))
+        # shard i's row j goes to shard j's slot i: a block transpose
+        expected = x.reshape(8, 8, 1).transpose(1, 0, 2).reshape(64, 1)
+        np.testing.assert_allclose(out, expected)
+        # non-divisible per-shard rows (9 per shard, split by 8) must fail
+        bad = np.zeros((72, 1), np.float32)
+        with pytest.raises(Exception):
+            comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(bad)

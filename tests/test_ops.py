@@ -8,7 +8,7 @@ import pytest
 from raft_tpu.ops import topk_pallas
 
 
-@pytest.mark.parametrize("m,n,k", [(8, 256, 4), (16, 1000, 10), (9, 130, 128)])
+@pytest.mark.parametrize("m,n,k", [(8, 256, 4), (16, 1000, 10), (9, 130, 64)])
 def test_topk_pallas_matches_lax(rng, m, n, k):
     import jax.numpy as jnp
     from jax import lax
@@ -38,4 +38,23 @@ def test_topk_pallas_k_too_big(rng):
 
     x = jnp.zeros((4, 64), jnp.float32)
     with pytest.raises(ValueError):
-        topk_pallas(x, 129)
+        topk_pallas(x, 65)
+
+
+def test_topk_pallas_inf_inputs(rng):
+    """Masked +inf entries (select_min) must lose to every finite entry but
+    still be picked — with their real column ids — when a row has fewer than
+    k finite values (the knn_merge_parts masked-slot pattern)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    x = rng.random((6, 300)).astype(np.float32)
+    x[0, 5:] = np.inf          # row 0: only 5 finite entries
+    x[1, ::2] = np.inf
+    xj = jnp.asarray(x)
+    v, i = topk_pallas(xj, 8, select_min=True, blk=128)
+    v0, i0 = lax.top_k(-xj, 8)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(-v0))
+    # row 0 slots 5..7 are +inf but must carry REAL in-range column ids
+    assert np.isinf(np.asarray(v)[0, 5:]).all()
+    assert (np.asarray(i) >= 0).all() and (np.asarray(i) < 300).all()
