@@ -43,6 +43,13 @@ if os.environ.get("JAX_PLATFORMS"):
     # the shared recipe lives in raft_tpu.core.platform.force_virtual_cpu;
     # this path keeps the user's explicit platform choice instead of cpu
 
+# persistent jit cache: repeat harness runs skip 1M-scale compiles entirely
+# (docs/warm_builds.md); RAFT_TPU_NO_JIT_CACHE=1 opts out for cold timings
+if not os.environ.get("RAFT_TPU_NO_JIT_CACHE"):
+    import raft_tpu.config
+
+    raft_tpu.config.enable_compilation_cache()
+
 
 def load_dataset(spec: dict):
     """Return (base (n,d) f32, queries (m,d) f32, metric str)."""

@@ -3,9 +3,10 @@
 Re-design of the reference's select_k (cpp/include/raft/matrix/select_k.cuh;
 two CUDA algorithms — 11-bit radix filter detail/select_radix.cuh and warp
 bitonic queues detail/select_warpsort.cuh — picked by a learned heuristic,
-detail/select_k-inl.cuh:46). On TPU the baseline is XLA's native TopK
-(`lax.top_k`), which lowers to a tuned sort-based selector; a Pallas
-block-bitonic variant for very large n lives in raft_tpu.ops. The payload
+detail/select_k-inl.cuh:46). The TPU mirror of that two-algorithm split:
+XLA's native TopK (`lax.top_k`, a tuned sort) below ~64k columns, and the
+threshold-gated streaming Pallas selector (raft_tpu.ops.topk_pallas, one HBM
+pass) for wide rows with k <= 64. The payload
 (caller-provided source indices, used when merging per-shard candidate lists)
 is carried by gathering with the top-k permutation.
 """

@@ -2,6 +2,7 @@
 IVF scan fusions). Population grows as profiling identifies XLA-composition
 bottlenecks; modules land here with benchmarks."""
 
+from .fused_knn import FUSED_KNN_MAX_K, fused_knn
 from .topk import TOPK_MAX_K, topk_pallas
 
-__all__ = ["topk_pallas", "TOPK_MAX_K"]
+__all__ = ["topk_pallas", "TOPK_MAX_K", "fused_knn", "FUSED_KNN_MAX_K"]
