@@ -59,6 +59,20 @@ def fused_l2_nn(x, y, sqrt: bool = False, res: Resources | None = None):
     y = jnp.asarray(y)
     expects(x.ndim == 2 and y.ndim == 2, "inputs must be 2-D matrices")
     expects(x.shape[1] == y.shape[1], "feature dims must match")
+    # Large candidate sets on TPU: this is exactly the fused kNN kernel with
+    # k=1 (scores never reach HBM). Small n (e.g. k-means assignment against
+    # ~1k centers) stays on the XLA path where the score block is tiny and
+    # the GEMM dominates anyway; the shared gate also keeps small-d inputs
+    # (which would mostly multiply lane padding) on the XLA path.
+    from ..ops.fused_knn import fused_backend_ok, shapes_eligible
+
+    backend_ok, interpret = fused_backend_ok()
+    if backend_ok and shapes_eligible(y.shape[0], y.shape[1], 1):
+        from ..ops.fused_knn import fused_knn
+
+        dist, idx = fused_knn(y, x, 1, metric="l2", sqrt=sqrt,
+                              interpret=interpret)
+        return dist[:, 0], idx[:, 0]
     # Only the (tile, n) score block is live per step (d≈0 in the memory
     # model), so tiles are ~d× larger than the elementwise-metric path's.
     tile = _choose_tile(x.shape[0], y.shape[0], 1, res.workspace_bytes)

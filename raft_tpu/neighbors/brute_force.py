@@ -39,23 +39,14 @@ _FUSED_L2 = {
 
 
 def _fused_eligible(metric, k, n, d, mode, compute):
-    import os
+    from ..ops.fused_knn import fused_backend_ok, shapes_eligible
 
-    from ..ops.fused_knn import FUSED_KNN_MAX_K
-
-    # the kernel is Mosaic-compiled on TPU only; elsewhere it would run in
-    # interpret-mode emulation, which is far slower than the XLA path — tests
-    # opt in explicitly via RAFT_TPU_FUSED_KNN_INTERPRET=1
-    on_tpu = jax.default_backend() == "tpu"
-    interpret_ok = os.environ.get("RAFT_TPU_FUSED_KNN_INTERPRET", "").lower() in (
-        "1", "true", "yes")
+    backend_ok, _ = fused_backend_ok()
     return (
-        (on_tpu or interpret_ok)
+        backend_ok
         and mode == "exact"
         and compute in ("float32", "float32x3", "bfloat16")
-        and 0 < k <= FUSED_KNN_MAX_K
-        and n >= 4096
-        and d <= 4096
+        and shapes_eligible(n, d, k)
         and (metric in _FUSED_L2
              or metric in (DistanceType.InnerProduct, DistanceType.CosineExpanded))
     )

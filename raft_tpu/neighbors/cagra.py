@@ -66,6 +66,11 @@ class IndexParams:
     # 3.0 compensates pq4's coarser candidate ordering — the wider exact
     # refine pool costs far less than pq8's 10x-slower LUT scan
     refine_rate: float = 3.0
+    # NOTE a bf16 hop-scoring dataset copy was tried and measured WORSE on
+    # both axes at 1M x 128 (QPS 28.5k -> 26.1k, recall 0.971 -> 0.699 at
+    # itopk=32): the per-hop vector fetches are latency-bound, not
+    # bandwidth-bound, and bf16 score noise misorders the beam on tight
+    # clusters. Removed; measurement recorded in BASELINE.md.
     # query rows per device dispatch during the self-search/refine phases —
     # keeps any single device program under watchdog/VMEM pressure limits.
     # Honored down to 1 (lower = more, smaller dispatches; useful when VMEM
@@ -384,13 +389,13 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
     return out_d, beam_ids[:, :k]
 
 
-@auto_convert_output
 def resolve_max_iterations(params: SearchParams) -> int:
     """Default hop budget (reference: adjust_search_params, cagra_search.cuh)."""
     return params.max_iterations or (
         params.itopk_size // max(params.search_width, 1) + 10)
 
 
+@auto_convert_output
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
     cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD)."""

@@ -49,6 +49,26 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["fused_knn", "FUSED_KNN_MAX_K"]
 
 FUSED_KNN_MAX_K = 64          # merge buffer is one 128-lane register: 2k <= 128
+
+
+def fused_backend_ok():
+    """True when the fused kernel may run: Mosaic on TPU, or interpret mode
+    explicitly opted into for tests (RAFT_TPU_FUSED_KNN_INTERPRET=1)."""
+    import os
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret_ok = os.environ.get(
+        "RAFT_TPU_FUSED_KNN_INTERPRET", "").lower() in ("1", "true", "yes")
+    return on_tpu or interpret_ok, not on_tpu
+
+
+def shapes_eligible(n: int, d: int, k: int) -> bool:
+    """Shared shape gate for fused-kernel dispatch: big-enough candidate set
+    (below ~4096 rows XLA is fine and kernel padding overhead dominates),
+    feature dim within the VMEM budget, and d not dominated by lane padding
+    (inputs are zero-padded to 128 lanes; d << 64 would mostly multiply
+    zeros and pay a padded dataset copy per call)."""
+    return 0 < k <= FUSED_KNN_MAX_K and n >= 4096 and 64 <= d <= 4096
 _NEG = -3.0e38                # finite sentinel: 0 * _NEG must stay finite
 _BIG = 2**30                  # "no index" sentinel
 

@@ -176,3 +176,19 @@ class TestFusedL2NN:
         _, idx = fused_l2_nn(x, y, res=small)
         full = sp_dist.cdist(x, y, "sqeuclidean")
         np.testing.assert_array_equal(np.asarray(idx), full.argmin(1))
+
+
+def test_fused_l2_nn_large_n_kernel_dispatch(rng, monkeypatch):
+    """At n >= 4096 fused_l2_nn routes through the fused Pallas kernel with
+    k=1; results must match the XLA path exactly."""
+    monkeypatch.setenv("RAFT_TPU_FUSED_KNN_INTERPRET", "1")
+    import jax.numpy as jnp
+    from raft_tpu.distance import fused_l2_nn
+    from raft_tpu.distance.fused_nn import _fused_l2_nn
+
+    x = jnp.asarray(rng.random((200, 80)).astype(np.float32))
+    y = jnp.asarray(rng.random((4500, 80)).astype(np.float32))
+    d, i = fused_l2_nn(x, y)
+    d0, i0 = _fused_l2_nn(x, y, False, 200)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i0))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d0), rtol=1e-5, atol=1e-5)

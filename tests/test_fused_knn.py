@@ -15,7 +15,7 @@ from raft_tpu.distance.types import DistanceType
 from raft_tpu.neighbors.brute_force import _bf_knn, knn
 from raft_tpu.ops.fused_knn import fused_knn
 
-N, D, M, K = 4500, 24, 300, 10  # n >= 4096 so knn() dispatches to the kernel
+N, D, M, K = 4500, 72, 300, 10  # n >= 4096, d >= 64: knn() dispatches to the kernel
 
 
 def assert_knn_equiv(dv, di, rd, ri, rtol=1e-5, atol=1e-6):
