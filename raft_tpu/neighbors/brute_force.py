@@ -54,10 +54,10 @@ def _fused_eligible(metric, k, n, d, mode, compute):
 
 def _bf_knn_fused(dataset, queries, k, metric, compute, keep_mask):
     """Route to the fused Pallas kernel (scores never leave VMEM)."""
-    from ..ops.fused_knn import fused_knn
+    from ..ops.fused_knn import fused_backend_ok, fused_knn
 
     mode = {"float32": "f32", "float32x3": "f32x3", "bfloat16": "bf16"}[compute]
-    interpret = jax.default_backend() != "tpu"
+    _, interpret = fused_backend_ok()
     if metric in _FUSED_L2:
         return fused_knn(dataset, queries, k, metric="l2", mode=mode,
                          keep_mask=keep_mask, sqrt=_FUSED_L2[metric],
@@ -140,9 +140,10 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     (single-pass MXU contraction — same neighbor ordering in all but
     razor-thin margins, several times the GEMM throughput).
 
-    On TPU, L2/inner-product/cosine searches with k ≤ 64 and n ≥ 4096
-    dispatch to the fused Pallas kernel (ops/fused_knn.py) — same neighbor
-    sets; within-1-ULP distance ties may order differently.
+    On TPU, L2/inner-product/cosine searches with k ≤ 64, n ≥ 4096 and
+    64 ≤ d ≤ 4096 dispatch to the fused Pallas kernel (ops/fused_knn.py;
+    smaller d would mostly multiply 128-lane padding) — same neighbor sets;
+    within-1-ULP distance ties may order differently.
     Returns (distances (m, k), indices (m, k))."""
     from .sample_filter import resolve_filter
 
