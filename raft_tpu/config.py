@@ -71,6 +71,12 @@ def get_output_as() -> str | Callable:
 def _convert(value: Any) -> Any:
     if _output_as == "jax":
         return value
+    if isinstance(value, jax.core.Tracer):
+        # inside someone's jit trace (a converted entry point called from a
+        # user-jitted function, or one entry point composing another):
+        # host conversion is impossible and wrong — pass tracers through;
+        # the OUTERMOST eager call converts the final outputs
+        return value
     if isinstance(value, jax.Array):
         if callable(_output_as):
             return _output_as(value)

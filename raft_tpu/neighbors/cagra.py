@@ -156,24 +156,47 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     # query the dataset against itself in host-side chunks (one giant
     # dispatch trips device watchdogs at 100k+ rows; the reference batches
     # here too — cagra_build.cuh:86 loops over max_batch_size query blocks),
-    # k+1 then drop self
-    sp = ivf_pq_mod.SearchParams(n_probes=params.build_n_probes)
+    # k+1 then drop self. The whole per-chunk pipeline (PQ search + exact
+    # refine + self-edge drop) is ONE jitted program: on a slow tunnel the
+    # per-dispatch RPC dominates the build (identical code measured 228 s to
+    # 20+ min), so 62 chunks must cost 62 round trips, not ~400.
     chunk = max(int(params.build_chunk), 1)
+    mt = resolve_metric(params.metric)
     parts = []
     for s in range(0, n, chunk):
         xb = x[s:s + chunk]
-        _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1, res=res)
-        _, refined = refine(x, xb, cand, k + 1, metric=params.metric, res=res)
-        # drop self-edges (ref: build_knn_graph removes the query itself)
         rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
-        self_col = refined == rows[:, None]
-        # shift left past self matches: mask self then take first k valid
-        big = jnp.where(
-            self_col, jnp.iinfo(jnp.int32).max, jnp.arange(k + 1, dtype=jnp.int32)[None, :]
-        )
-        order = jnp.argsort(big, axis=1)[:, :k]
-        parts.append(jnp.take_along_axis(refined, order, axis=1))
+        parts.append(_build_chunk_step(
+            x, pq, xb, rows, int(params.build_n_probes), int(gpu_top_k),
+            int(k), mt))
     return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_probes", "gpu_top_k", "k", "metric"))
+def _build_chunk_step(x, pq, xb, rows, n_probes: int, gpu_top_k: int, k: int,
+                      metric):
+    """One knn-graph build chunk — PQ search + exact refine + self-edge drop —
+    as a single program: on a slow tunnel the per-dispatch RPC dominates the
+    build (identical code measured 228 s to 20+ min), so N chunks must cost N
+    round trips, not ~6N. Module-level and argument-passing (x/pq are jit
+    arguments, not closure constants) so the compilation caches across
+    build() calls."""
+    from . import ivf_pq as ivf_pq_mod
+    from .refine import refine
+
+    sp = ivf_pq_mod.SearchParams(n_probes=n_probes)
+    _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1)
+    _, refined = refine(x, xb, cand, k + 1, metric=metric)
+    # drop self-edges (ref: build_knn_graph removes the query itself)
+    self_col = refined == rows[:, None]
+    # shift left past self matches: mask self then take first k valid
+    big = jnp.where(
+        self_col, jnp.iinfo(jnp.int32).max,
+        jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    )
+    order = jnp.argsort(big, axis=1)[:, :k]
+    return jnp.take_along_axis(refined, order, axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("out_degree", "tile"))

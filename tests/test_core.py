@@ -149,3 +149,24 @@ class TestSerializationVersion:
         p2 = str(tmp_path / "b.bin")
         ivf_pq.save(pq, p2)
         assert ivf_pq.load(p2).pq_bits == pq.pq_bits
+
+
+def test_output_conversion_skips_tracers(rng):
+    """@auto_convert_output entry points called inside a user's jit must pass
+    tracers through untouched (the eager outermost call converts); with
+    set_output_as('numpy') a traced conversion would raise."""
+    import jax
+    import jax.numpy as jnp
+
+    import raft_tpu.config as config
+    from raft_tpu.matrix import select_k
+
+    x = jnp.asarray(rng.random((4, 32), "float32"))
+    config.set_output_as("numpy")
+    try:
+        v, i = jax.jit(lambda a: select_k(a, 3))(x)   # traced call: no convert
+        assert isinstance(v, jax.Array)
+        v2, i2 = select_k(x, 3)                        # eager call: converts
+        assert isinstance(v2, np.ndarray)
+    finally:
+        config.set_output_as("jax")
