@@ -75,7 +75,10 @@ def _measure_qps(search_fn, query_sets, m, use_jit=True):
 
 
 def _flagship_exact(rows):
-    """Exact kNN 100k x 128 — identical protocol to BENCH_r01."""
+    """Exact kNN 100k x 128 — identical protocol to BENCH_r01.
+
+    Returns (primary_qps, fused_ok): qps is 0.0 when nothing measured (a
+    complete environmental failure) — main() still emits the snapshot."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -98,13 +101,40 @@ def _flagship_exact(rows):
             dataset, q, k, DistanceType.L2Expanded, "float32", None), qs)
 
     qsets = [one_set(kk) for kk in kq]
-    qps, _ = _measure_qps(searches, qsets, n_batches * m)
-    rows.append({"name": "exact_fused_knn_100k", "qps": round(qps, 1),
-                 "recall": 1.0, "build_s": 0.0})
+    fused_ok = True
+    try:
+        qps, _ = _measure_qps(searches, qsets, n_batches * m)
+        rows.append({"name": "exact_fused_knn_100k", "qps": round(qps, 1),
+                     "recall": 1.0, "build_s": 0.0})
+    except Exception as e:  # pragma: no cover - bench resilience
+        # fused-kernel failure (e.g. a Mosaic lowering change) must not kill
+        # the whole bench: fall back to the XLA GEMM+top_k pipeline so A
+        # primary number still prints, clearly labeled as the fallback (the
+        # top-level vs_baseline is nulled by main() so rounds are not
+        # compared apples-to-oranges)
+        from raft_tpu.neighbors.brute_force import _bf_knn
+
+        fused_ok = False
+        rows.append({"name": "exact_fused_knn_100k", "error": str(e)[:200]})
+        try:
+            def searches_xla(qs):
+                return lax.map(lambda q: _bf_knn(
+                    dataset, q, k, DistanceType.L2Expanded, 2.0, 1000, 1000), qs)
+
+            qps, _ = _measure_qps(searches_xla, qsets, n_batches * m)
+            rows.append({"name": "exact_xla_knn_100k_fallback",
+                         "qps": round(qps, 1), "recall": 1.0, "build_s": 0.0})
+        except Exception as e2:  # environmental: emit what we have
+            rows.append({"name": "exact_xla_knn_100k_fallback",
+                         "error": str(e2)[:200]})
+            return 0.0, False
 
     # bf16-compute row measured alongside (VERDICT r1 #2): same kernel, one
     # MXU pass instead of six; ~0.98 worst-case set recall on uniform data.
-    # Guarded: a bf16-path failure must not lose the measured f32 row.
+    # Guarded: a bf16-path failure must not lose the measured f32 row; and if
+    # the fused kernel already failed, don't recompile it just to fail again.
+    if not fused_ok:
+        return qps, fused_ok
     try:
         def searches_bf16(qs):
             return lax.map(lambda q: _bf_knn_fused(
@@ -115,7 +145,7 @@ def _flagship_exact(rows):
                      "qps": round(qps16, 1), "recall": None, "build_s": 0.0})
     except Exception as e:  # pragma: no cover - bench resilience
         rows.append({"name": "exact_fused_knn_100k_bf16", "error": str(e)[:200]})
-    return qps
+    return qps, fused_ok
 
 
 def _make_1m():
@@ -140,14 +170,16 @@ def _make_1m():
     return dataset, qsets
 
 
-def _emit(primary_qps, rows):
+def _emit(primary_qps, rows, fused_ok=True):
     """Print the full result line; called after every completed row so the
-    last line on stdout is always a complete, parseable snapshot."""
+    last line on stdout is always a complete, parseable snapshot. When the
+    fused kernel did not run, vs_baseline is null — the fallback's XLA number
+    must not read as a regression of the same pipeline."""
     print(json.dumps({
         "metric": "exact brute-force kNN QPS (100k x 128 f32, k=10, batch 10k)",
         "value": round(primary_qps, 1),
         "unit": "QPS",
-        "vs_baseline": round(primary_qps / 110805.2, 3),
+        "vs_baseline": round(primary_qps / 110805.2, 3) if fused_ok else None,
         "rows": rows,
         "elapsed_s": round(_elapsed(), 1),
     }), flush=True)
@@ -159,8 +191,8 @@ def main():
 
     rows = []
     _note("flagship exact 100k")
-    primary_qps = _flagship_exact(rows)
-    _emit(primary_qps, rows)
+    primary_qps, fused_ok = _flagship_exact(rows)
+    _emit(primary_qps, rows, fused_ok)
 
     gt = None
     try:
@@ -201,7 +233,7 @@ def main():
                          "build_s": round(build_s, 1)})
         except Exception as e:  # pragma: no cover
             rows.append({"name": "ivf_flat_1m_p8", "error": str(e)[:200]})
-        _emit(primary_qps, rows)
+        _emit(primary_qps, rows, fused_ok)
 
     if gt is not None and _elapsed() < SOFT_BUDGET_S:
         try:
@@ -225,7 +257,7 @@ def main():
 
     # the reference publishes no absolute numbers (BASELINE.md); the recorded
     # round-1 flagship (110,805 QPS, BENCH_r01.json) is the progress baseline
-    _emit(primary_qps, rows)
+    _emit(primary_qps, rows, fused_ok)
 
 
 if __name__ == "__main__":
