@@ -48,7 +48,6 @@ _SUBMODULES = {
     "label",
     "ops",
     "parallel",
-    "utils",
     "spatial",
     "config",
 }

@@ -58,9 +58,12 @@ def select_k(values, k: int, select_min: bool = True, indices=None):
     # Wide rows on TPU: the streaming Pallas selector (ops/topk.py) reads the
     # matrix once vs the TopK custom call's ~3 sort passes — measured 1.3x at
     # (1000, 100k) k=10 (18.3 vs 23.8 ms/iter chained); parity below ~64k
-    # columns, so the dispatch stays conservative.
+    # columns, so the dispatch stays conservative. Restricted to <=32-bit
+    # floats: the kernel ranks after an f32 cast, so under jax_enable_x64 a
+    # float64 row whose entries differ only beyond f32 precision would be
+    # silently misranked vs the exact lax.top_k path.
     if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 64
-            and jnp.issubdtype(values.dtype, jnp.floating)):
+            and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         from ..ops.topk import topk_pallas
 
         out_v, pos = topk_pallas(values, int(k), select_min=bool(select_min))

@@ -95,6 +95,15 @@ def bound_capacity(labels, n_lists: int, factor: float = 1.3):
     return new_labels, rep, int(rep.sum()), cap_target
 
 
+def pq_scan_bytes_per_probe_row(capacity: int, pq_dim: int, n_codes: int) -> int:
+    """Memory model for one (query, probe) pair of the PQ LUT scan, shared by
+    the single-chip and distributed searches: codes gather (uint8) + gathered
+    LUT values (f32) + scores (f32) per capacity slot, plus the LUT itself;
+    x2 for XLA temporaries (the gather and its consumer co-exist) —
+    undercounting here OOMed the device at 1M scale."""
+    return 2 * (capacity * pq_dim * 9 + pq_dim * n_codes * 8)
+
+
 def plan_search_tiles(m: int, n_probes: int, k: int, capacity: int,
                       bytes_per_probe_row: int, budget_bytes: int,
                       max_query_tile: int = 256):

@@ -168,26 +168,31 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
         rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
         parts.append(_build_chunk_step(
             x, pq, xb, rows, int(params.build_n_probes), int(gpu_top_k),
-            int(k), mt))
+            int(k), mt, int(res.workspace_bytes)))
     return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_probes", "gpu_top_k", "k", "metric"))
+    jax.jit,
+    static_argnames=("n_probes", "gpu_top_k", "k", "metric", "workspace_bytes"))
 def _build_chunk_step(x, pq, xb, rows, n_probes: int, gpu_top_k: int, k: int,
-                      metric):
+                      metric, workspace_bytes: int):
     """One knn-graph build chunk — PQ search + exact refine + self-edge drop —
     as a single program: on a slow tunnel the per-dispatch RPC dominates the
     build (identical code measured 228 s to 20+ min), so N chunks must cost N
     round trips, not ~6N. Module-level and argument-passing (x/pq are jit
     arguments, not closure constants) so the compilation caches across
-    build() calls."""
+    build() calls. ``workspace_bytes`` (static) threads the caller's
+    Resources budget into the dominant build phase, so a constrained
+    workspace bounds the PQ scan block here too."""
     from . import ivf_pq as ivf_pq_mod
     from .refine import refine
+    from ..core.resources import Resources
 
+    chunk_res = Resources(workspace_bytes=workspace_bytes)
     sp = ivf_pq_mod.SearchParams(n_probes=n_probes)
-    _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1)
-    _, refined = refine(x, xb, cand, k + 1, metric=metric)
+    _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1, res=chunk_res)
+    _, refined = refine(x, xb, cand, k + 1, metric=metric, res=chunk_res)
     # drop self-edges (ref: build_knn_graph removes the query itself)
     self_col = refined == rows[:, None]
     # shift left past self matches: mask self then take first k valid

@@ -45,7 +45,8 @@ from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
 from ..random.rng import as_key
 from ._list_utils import (assign_to_lists, bound_capacity, list_positions,
-                          plan_search_tiles, round_up)
+                          plan_search_tiles, pq_scan_bytes_per_probe_row,
+                          round_up)
 
 __all__ = ["IndexParams", "SearchParams", "IvfPqIndex", "build", "extend", "search", "save", "load"]
 
@@ -551,7 +552,13 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     filtered overload neighbors/ivf_pq.cuh search_with_filtering).
 
     Returns (distances (m, k), ids (m, k)); distances are approximate
-    (PQ-quantized), id -1 marks empty candidate slots."""
+    (PQ-quantized), id -1 marks empty candidate slots.
+
+    Tracer caveat: when ``index`` is passed as a jit argument its
+    ``list_sizes`` is a tracer, so the "index is empty" guard (like the
+    ``index.size`` property) cannot run — searching an empty index inside a
+    user jit returns all-sentinel results (-1 ids, +inf distances) instead
+    of raising."""
     from .sample_filter import resolve_filter
 
     res = res or default_resources()
@@ -567,15 +574,11 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
             "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
             params.lut_dtype)
-    # chunk memory model: codes gather (uint8) + gathered LUT values (f32) +
-    # scores (f32) per capacity slot, plus the LUT itself; x2 for XLA
-    # temporaries (the gather and its consumer co-exist) — undercounting here
-    # OOMed the device at 1M scale
     n_codes = index.codebooks.shape[-2]
     query_tile, probe_chunk = plan_search_tiles(
         m, n_probes, int(k), index.capacity,
-        bytes_per_probe_row=2 * (index.capacity * index.pq_dim * 9
-                                 + index.pq_dim * n_codes * 8),
+        bytes_per_probe_row=pq_scan_bytes_per_probe_row(
+            index.capacity, index.pq_dim, n_codes),
         budget_bytes=res.workspace_bytes,
         max_query_tile=128,
     )

@@ -115,6 +115,14 @@ def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
     defaults to True off-TPU (Pallas interpreter) so the kernel is testable
     on the CPU mesh. k <= TOPK_MAX_K; larger k belongs to lax.top_k (the
     matrix/select_k.py dispatch handles that split).
+
+    Magnitude limit: ranking happens after a clamp to +/-2.9e38 (so +/-inf
+    inputs still beat the padding sentinel), which collapses finite f32
+    magnitudes in (2.9e38, 3.4e38] with each other and with +/-inf — among
+    such values the selected *index* can differ from lax.top_k (returned
+    values are exact either way, restored by the final gather). Pre-scale
+    inputs if distinctions above 2.9e38 matter; distance pipelines never get
+    near this range.
     """
     m, n = x.shape
     if k > min(TOPK_MAX_K, n):

@@ -161,7 +161,8 @@ def _pad_pq_lists(index, size: int):
     )
 
 
-def search_pq(comms: Comms, params, index, queries, k: int):
+def search_pq(comms: Comms, params, index, queries, k: int,
+              res=None):
     """Distributed IVF-PQ search: lists sharded over the mesh axis, local LUT
     scans, one all_gather + select_k merge (the same composition as IVF-Flat
     ``search`` above; reference pattern: per-shard indexes + knn_merge_parts,
@@ -174,8 +175,12 @@ def search_pq(comms: Comms, params, index, queries, k: int):
 
     Returns replicated (distances (m, k), global ids (m, k)).
     """
+    from ..core.resources import default_resources
+    from ..neighbors._list_utils import (plan_search_tiles,
+                                         pq_scan_bytes_per_probe_row)
     from ..neighbors.ivf_pq import IvfPqIndex, _pq_search
 
+    res = res or default_resources()
     queries = jnp.asarray(queries)
     size = comms.size()
     index = _pad_pq_lists(index, size)
@@ -183,6 +188,16 @@ def search_pq(comms: Comms, params, index, queries, k: int):
     lists_per_shard = L // size
     n_probes = min(params.n_probes, lists_per_shard)
     expects(0 < k <= n_probes * index.capacity, "k exceeds per-shard candidate pool")
+    # same workspace model as the single-chip ivf_pq.search, with shard-local
+    # n_probes/capacity
+    n_codes = index.codebooks.shape[-2]
+    query_tile, probe_chunk = plan_search_tiles(
+        queries.shape[0], n_probes, int(k), index.capacity,
+        bytes_per_probe_row=pq_scan_bytes_per_probe_row(
+            index.capacity, index.pq_dim, n_codes),
+        budget_bytes=res.workspace_bytes,
+        max_query_tile=128,
+    )
     inner = index.metric == DistanceType.InnerProduct
     per_cluster = index.codebook_kind == "per_cluster"
     expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
@@ -196,7 +211,7 @@ def search_pq(comms: Comms, params, index, queries, k: int):
             pq_bits=index.pq_bits, split_factor=index.split_factor)
         d_loc, i_loc = _pq_search(
             shard, q, n_probes, k,
-            query_tile=min(128, q.shape[0]), probe_chunk=n_probes,
+            query_tile=query_tile, probe_chunk=probe_chunk,
             metric=index.metric, codebook_kind=index.codebook_kind,
             lut_dtype=params.lut_dtype)
         d_all = comms.allgather(d_loc)
