@@ -36,7 +36,16 @@ __all__ = [
 # pre-versioning streams — whose next scalar was a small int — fail the check
 # with a clear message instead of being misread.
 #   raft_tpu/2: version header added; ivf_flat/ivf_pq carry split_factor.
-SERIALIZATION_VERSION = "raft_tpu/2"
+#   raft_tpu/3: ivf_pq carries pq_split + list_consts (nibble-split pq8).
+SERIALIZATION_VERSION = "raft_tpu/3"
+
+# Older versions each tag can still READ (only ivf_pq's layout changed in
+# raft_tpu/3, so ivf_flat/cagra files saved under raft_tpu/2 stay loadable —
+# bumping the global version must not force rebuilds of unchanged formats).
+_READ_COMPATIBLE: dict[str, frozenset[str]] = {
+    "ivf_flat": frozenset({"raft_tpu/2"}),
+    "cagra": frozenset({"raft_tpu/2"}),
+}
 
 
 def serialize_header(fp: BinaryIO, tag: str) -> None:
@@ -53,8 +62,9 @@ def check_header(fp: BinaryIO, tag: str) -> None:
     article = "an" if tag[:1] in "aeiou" else "a"
     expects(got == tag, "not %s %s index file (tag=%r)", article, tag, got)
     ver = deserialize_scalar(fp)
+    ok = ver == SERIALIZATION_VERSION or ver in _READ_COMPATIBLE.get(tag, ())
     expects(
-        ver == SERIALIZATION_VERSION,
+        ok,
         "unsupported %s index file format %r (this build reads %r) — the file "
         "was written by an incompatible raft_tpu version; rebuild and re-save "
         "the index",

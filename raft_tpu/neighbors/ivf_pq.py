@@ -37,6 +37,7 @@ from jax import lax
 from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core.errors import expects
+from ..core.logger import logger
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               serialize_header, serialize_mdspan, serialize_scalar)
@@ -79,6 +80,20 @@ class IndexParams:
     # ivf_flat: 1.3 measured +68% QPS (20.5k -> 34.4k at 1M, p=8) at
     # identical recall
     split_factor: float = 1.3
+    # pq_bits=8 layout. True: two-stage 4+4-bit residual quantizer per
+    # subspace — the codeword is cb1[hi_nibble] + cb2[lo_nibble], so the
+    # scan's one-hot contraction axis is pq_dim*32 instead of pq_dim*256 (8x
+    # less MXU work; for L2 the query-independent cross term 2*cb1·cb2 is
+    # precomputed per vector at encode time into list_consts). Same 8 code
+    # bits per subspace; the representable set is the Minkowski sum of two
+    # 16-entry codebooks. False: the reference's joint 256-entry codebook
+    # (ivf_pq_compute_similarity's LUT), ~8x slower to scan on TPU but a
+    # finer quantizer. None (default) = metric-aware auto: split for L2
+    # (measured ~12% relative bare-recall cost for a 8x QPS gain,
+    # BASELINE.md), joint for inner_product (the Minkowski coarseness costs
+    # IP ranking far more — measured recall@5 0.375 joint vs 0.075 split on
+    # tight clusters at 4x compression).
+    pq8_split: bool | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,15 +116,21 @@ class IvfPqIndex:
     centers: jax.Array  # (n_lists, d) f32 coarse centers
     centers_rot: jax.Array  # (n_lists, d_rot) f32 — rotated centers
     rotation: jax.Array  # (d_rot, d) f32 orthonormal
-    codebooks: jax.Array  # per_subspace: (pq_dim, 2**bits, pq_len); per_cluster: (n_lists, 2**bits, pq_len)
+    codebooks: jax.Array  # per_subspace: (pq_dim, K, pq_len); per_cluster: (n_lists, K, pq_len); K = 2**bits, or 2*16 when pq_split
     list_codes: jax.Array  # (n_lists, capacity, pq_dim) uint8
     list_ids: jax.Array  # (n_lists, capacity) int32, -1 padding
     list_sizes: jax.Array  # (n_lists,) int32
+    # (n_lists, capacity) f32 per-vector scan constant for pq_split L2
+    # (sum_s 2*cb1[s,hi_s]·cb2[s,lo_s]); (n_lists, 0) otherwise
+    list_consts: jax.Array = None
     metric: DistanceType = DistanceType.L2Expanded
     codebook_kind: str = "per_subspace"
     pq_bits: int = 8
     # build-time capacity policy, inherited by extend()
     split_factor: float = 1.3
+    # True: codes are hi/lo nibble pairs into two 16-entry stage codebooks
+    # (codebooks[..., :16, :] and [..., 16:, :]); see IndexParams.pq8_split
+    pq_split: bool = False
 
     @property
     def n_lists(self) -> int:
@@ -146,15 +167,20 @@ class IvfPqIndex:
 
         return int(np.asarray(jax.device_get(self.list_sizes)).sum())
 
+    def __post_init__(self):
+        if self.list_consts is None:
+            self.list_consts = jnp.zeros((self.list_codes.shape[0], 0), jnp.float32)
+
     def tree_flatten(self):
         children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
-                    self.list_codes, self.list_ids, self.list_sizes)
-        return children, (self.metric, self.codebook_kind, self.pq_bits, self.split_factor)
+                    self.list_codes, self.list_ids, self.list_sizes, self.list_consts)
+        return children, (self.metric, self.codebook_kind, self.pq_bits,
+                          self.split_factor, self.pq_split)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2],
-                   split_factor=aux[3])
+                   split_factor=aux[3], pq_split=aux[4])
 
 
 def _default_pq_dim(d: int, pq_bits: int = 4) -> int:
@@ -213,6 +239,140 @@ def _train_codebooks_batched(subvecs, key, n_codes: int, n_iters: int):
     return jax.vmap(one)(subvecs.astype(jnp.float32), keys)
 
 
+def _train_split_codebooks(subvecs, key, n_iters: int, refine_rounds: int = 3):
+    """Two-stage 4+4-bit residual codebooks (pq8_split): stage 1 is 16-means
+    over the subvectors, stage 2 is 16-means over the stage-1 residuals
+    (classic residual VQ), then ``refine_rounds`` of alternating
+    re-fitting under the JOINT encoding (additive-quantization style: encode
+    against the composed 256-codeword sum, re-fit each stage to the residual
+    of the other) — recovers part of the expressiveness gap vs a free
+    256-entry codebook. Returns (B, 32, pq_len): stage-1 entries in
+    [..., :16, :], stage-2 in [..., 16:, :]."""
+    k1, k2 = jax.random.split(key)
+    sv = subvecs.astype(jnp.float32)
+    cb1 = _train_codebooks_batched(sv, k1, 16, n_iters)
+
+    def stage1_residual(s, c):
+        d2 = jnp.sum(c * c, axis=1)[None, :] - 2.0 * s @ c.T
+        return s - jnp.take(c, jnp.argmin(d2, axis=1), axis=0)
+
+    resid2 = jax.vmap(stage1_residual)(sv, cb1)
+    cb2 = _train_codebooks_batched(resid2, k2, 16, n_iters)
+
+    def refine_one(s, c1, c2):
+        def round_(carry, _):
+            c1, c2 = carry
+            comp = (c1[:, None, :] + c2[None, :, :]).reshape(256, c1.shape[-1])
+            d2 = jnp.sum(comp * comp, axis=1)[None, :] - 2.0 * s @ comp.T
+            code = jnp.argmin(d2, axis=1)
+            hi, lo = code // 16, code % 16
+            oh_hi = jax.nn.one_hot(hi, 16, dtype=jnp.float32, axis=0)  # (16, n)
+            oh_lo = jax.nn.one_hot(lo, 16, dtype=jnp.float32, axis=0)
+            r1 = s - jnp.take(c2, lo, axis=0)
+            c1n = jnp.where(
+                (oh_hi.sum(1) > 0)[:, None],
+                (oh_hi @ r1) / jnp.maximum(oh_hi.sum(1), 1.0)[:, None], c1)
+            r2 = s - jnp.take(c1n, hi, axis=0)
+            c2n = jnp.where(
+                (oh_lo.sum(1) > 0)[:, None],
+                (oh_lo @ r2) / jnp.maximum(oh_lo.sum(1), 1.0)[:, None], c2)
+            return (c1n, c2n), None
+
+        (c1, c2), _ = lax.scan(round_, (c1, c2), None, length=refine_rounds)
+        return jnp.concatenate([c1, c2], axis=0)
+
+    return jax.vmap(refine_one)(sv, cb1, cb2)
+
+
+def _composed_codebooks(codebooks):
+    """Expand split codebooks (B, 32, L) to the effective 256-entry codebook
+    (B, 256, L); entry hi*16+lo = cb1[hi] + cb2[lo] (row-major flatten keeps
+    the hi/lo nibble order consistent with the scan)."""
+    cb = codebooks.astype(jnp.float32)
+    cb1, cb2 = cb[:, :16, :], cb[:, 16:, :]
+    comp = cb1[:, :, None, :] + cb2[:, None, :, :]
+    return comp.reshape(cb.shape[0], 256, cb.shape[-1])
+
+
+def _per_cluster_gain(resid, labels, codebooks, split: bool, key, n_iters: int,
+                      n_trial: int = 8, member_cap: int = 2048):
+    """Trial-train per-cluster codebooks on the ``n_trial`` largest clusters
+    and return err_per_cluster / err_per_subspace (< 1 = per-cluster
+    quantizes better). The empirical basis of the codebook-kind auto
+    heuristic (reference counterpart: the PER_CLUSTER codebook_gen mode,
+    ivf_pq_build.cuh:424 train_per_cluster — the reference leaves the choice
+    entirely to the caller)."""
+    import numpy as np
+
+    n, pq_dim, pq_len = resid.shape
+    cb_ps = codebooks[:, :16, :] if split else codebooks  # (pq_dim, K, L)
+    k_codes = cb_ps.shape[1]
+    counts = np.bincount(np.asarray(labels), minlength=1)
+    trial = np.argsort(counts)[::-1][:n_trial]
+    trial = trial[counts[trial] > 0]
+    lab_h = np.asarray(labels)
+    pools = []
+    cap = min(member_cap, int(counts[trial].max()))
+    for c in trial:
+        rows = np.nonzero(lab_h == c)[0]
+        rows = rows[np.arange(cap) % len(rows)]  # wraparound to fixed size
+        pools.append(rows)
+    pools = jnp.asarray(np.stack(pools))  # (C, cap)
+    rv = jnp.take(resid, pools, axis=0)  # (C, cap, pq_dim, L)
+
+    # per-subspace error: each subvector against its own subspace codebook
+    def ps_err(r):  # (cap, pq_dim, L)
+        d = (jnp.sum(cb_ps * cb_ps, axis=-1)[None]
+             - 2.0 * jnp.einsum("nsl,skl->nsk", r, cb_ps))
+        return jnp.sum(jnp.min(d, axis=-1) + jnp.sum(r * r, axis=-1))
+
+    err_ps = jnp.sum(jax.vmap(ps_err)(rv))
+
+    # trial per-cluster codebooks: pool subvectors across subspaces per cluster
+    flat = rv.reshape(len(trial), cap * pq_dim, pq_len)
+    cb_pc = _train_codebooks_batched(flat, key, k_codes, n_iters)
+
+    def pc_err(v, c):  # (cap*pq_dim, L), (K, L)
+        d = (jnp.sum(c * c, axis=-1)[None]
+             - 2.0 * v @ c.T)
+        return jnp.sum(jnp.min(d, axis=-1) + jnp.sum(v * v, axis=-1))
+
+    err_pc = jnp.sum(jax.vmap(pc_err)(flat, cb_pc))
+    return float(err_pc) / max(float(err_ps), 1e-30)
+
+
+def _pq_cross_consts(codes, codebooks, labels, per_cluster: bool):
+    """Per-vector scan constant for split L2 scoring: sum_s 2*cb1[s,hi_s]·
+    cb2[s,lo_s] — the cross term of ||cb1+cb2||^2 that the separated hi/lo
+    LUTs cannot carry. Query-independent, so it is paid once here (encode
+    time) instead of per (query, probe) at search."""
+    cb = codebooks.astype(jnp.float32)
+    X = 2.0 * jnp.einsum("bhl,bgl->bhg", cb[:, :16, :], cb[:, 16:, :])
+    Xf = X.reshape(-1)  # flat index b*256 + hi*16 + lo = b*256 + code
+    n, pq_dim = codes.shape
+    blk = min(65536, max(round_up(n, 8), 8))
+    num = -(-n // blk)
+    cp = jnp.pad(codes, ((0, num * blk - n), (0, 0))).astype(jnp.int32)
+    ct = cp.reshape(num, blk, pq_dim)
+    if per_cluster:
+        lp = jnp.pad(labels, (0, num * blk - n)).astype(jnp.int32)
+        lt = lp.reshape(num, blk)
+
+        def body(args):
+            cb_, lb_ = args
+            return jnp.sum(jnp.take(Xf, lb_[:, None] * 256 + cb_, axis=0), axis=1)
+
+        out = lax.map(body, (ct, lt))
+    else:
+        offs = jnp.arange(pq_dim, dtype=jnp.int32) * 256
+
+        def body(cb_):
+            return jnp.sum(jnp.take(Xf, cb_ + offs[None, :], axis=0), axis=1)
+
+        out = lax.map(body, ct)
+    return out.reshape(num * blk)[:n]
+
+
 @functools.partial(jax.jit, static_argnames=("per_cluster", "tile"))
 def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
     """Nearest codebook entry per subspace, as tiled GEMMs.
@@ -248,7 +408,7 @@ def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
     return codes.reshape(num * tile, -1)[:n]
 
 
-def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int):
+def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int, consts=None):
     """Scatter codes into padded lists (shared ivf::list scheme)."""
     n, pq_dim = codes.shape
     pos, counts = list_positions(labels, n_lists)
@@ -256,7 +416,11 @@ def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int):
     idbuf = jnp.full((n_lists, capacity), -1, jnp.int32)
     buf = buf.at[labels, pos].set(codes)
     idbuf = idbuf.at[labels, pos].set(ids.astype(jnp.int32))
-    return buf, idbuf, counts.astype(jnp.int32)
+    if consts is None:
+        cbuf = jnp.zeros((n_lists, 0), jnp.float32)
+    else:
+        cbuf = jnp.zeros((n_lists, capacity), jnp.float32).at[labels, pos].set(consts)
+    return buf, idbuf, counts.astype(jnp.int32), cbuf
 
 
 def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIndex:
@@ -275,8 +439,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
                DistanceType.InnerProduct),
         "ivf_pq supports L2 / inner_product metrics, got %s", mt.name,
     )
-    expects(params.codebook_kind in ("per_subspace", "per_cluster"),
-            "codebook_kind must be per_subspace|per_cluster")
+    expects(params.codebook_kind in ("per_subspace", "per_cluster", "auto"),
+            "codebook_kind must be per_subspace|per_cluster|auto")
 
     pq_dim = params.pq_dim or _default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
@@ -315,11 +479,46 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
 
     # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
     key, kc = jax.random.split(key)
-    if params.codebook_kind == "per_subspace":
+    split_pref = (params.pq8_split if params.pq8_split is not None
+                  else mt != DistanceType.InnerProduct)
+    split = params.pq_bits == 8 and split_pref
+
+    def train(pools):
+        if split:
+            return _train_split_codebooks(pools, kc, params.kmeans_n_iters)
+        return _train_codebooks_batched(pools, kc, n_codes, params.kmeans_n_iters)
+
+    kind = params.codebook_kind
+    if kind != "per_cluster":
         # (pq_dim, n_train, pq_len) — every subspace trains on all residuals
         sub = jnp.moveaxis(resid, 1, 0)
-        codebooks = _train_codebooks_batched(sub, kc, n_codes, params.kmeans_n_iters)
-    else:
+        codebooks = train(sub)
+        # codebook-kind heuristic: for "auto" ONLY, trial-train per-cluster
+        # codebooks on the largest clusters and adopt them when they quantize
+        # markedly better (the caller opted into the trial + possible ~3x
+        # build cost by choosing auto). Plain per_subspace builds — including
+        # internal ones like CAGRA's knn-graph IVF-PQ, which expose no
+        # codebook knob — pay nothing.
+        if kind == "auto":
+            if params.n_lists >= 16 and n_train >= 4 * params.n_lists:
+                key, kt = jax.random.split(key)
+                ratio = _per_cluster_gain(resid, labels, codebooks, split, kt,
+                                          min(params.kmeans_n_iters, 10))
+                if ratio < 0.9:
+                    logger.info(
+                        "ivf_pq auto codebooks: per-cluster trial error is "
+                        "%.2fx per-subspace — training per-cluster codebooks "
+                        "(reference PER_CLUSTER mode, ivf_pq_build.cuh:424)",
+                        ratio)
+                    kind = "per_cluster"
+                else:
+                    logger.info(
+                        "ivf_pq auto codebooks: per-cluster trial gains "
+                        "little (%.2fx) — keeping per-subspace codebooks",
+                        ratio)
+            if kind == "auto":
+                kind = "per_subspace"
+    if kind == "per_cluster":
         # per-cluster: pool subspace-vectors of each cluster's members.
         # Pad each cluster's pool to a fixed size for batching.
         pool_cap = round_up(max(int(jnp.max(jnp.bincount(labels, length=params.n_lists))), n_codes), 8)
@@ -331,7 +530,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         rows = jnp.take(order, starts[:, None] + offs)  # (n_lists, pool_cap)
         pools = jnp.take(resid.reshape(n_train, d_rot), rows, axis=0)  # (L, pool_cap, d_rot)
         pools = pools.reshape(params.n_lists, pool_cap * pq_dim, pq_len)
-        codebooks = _train_codebooks_batched(pools, kc, n_codes, params.kmeans_n_iters)
+        codebooks = train(pools)
 
     index = IvfPqIndex(
         centers=centers,
@@ -342,9 +541,10 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
         metric=mt,
-        codebook_kind=params.codebook_kind,
+        codebook_kind=kind,
         pq_bits=params.pq_bits,
         split_factor=params.split_factor,
+        pq_split=split,
     )
     if not params.add_data_on_build:
         return index
@@ -368,13 +568,24 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
     labels = assign_to_lists(x, index.centers, index.metric, tile)
     resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
     resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
-    n_codes = index.codebooks.shape[-2]
+    per_cluster = index.codebook_kind == "per_cluster"
+    # split indexes encode against the effective composed 256-entry codebook
+    # (joint argmin over the Minkowski sum — optimal for this codebook, and
+    # the flat composed index IS hi*16+lo)
+    enc_cb = _composed_codebooks(index.codebooks) if index.pq_split else index.codebooks
+    n_codes = enc_cb.shape[-2]
     enc_tile = max(min(n_new, res.workspace_bytes // max(index.pq_dim * n_codes * 4, 1)), 8)
     codes = _encode(
-        resid, index.codebooks, labels,
-        per_cluster=index.codebook_kind == "per_cluster",
+        resid, enc_cb, labels,
+        per_cluster=per_cluster,
         tile=min(enc_tile, 8192),
     )
+    consts = None
+    if index.pq_split and index.metric != DistanceType.InnerProduct:
+        # L2 scoring needs the per-vector cross term; IP scoring is exactly
+        # separable, so split IP indexes keep the empty (n_lists, 0) buffer
+        # (no dead capacity-sized zeros stored/serialized/sharded)
+        consts = _pq_cross_consts(codes, index.codebooks, labels, per_cluster)
 
     if index.capacity > 0 and index.size > 0:
         old_mask = index.list_ids.reshape(-1) >= 0
@@ -384,6 +595,9 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
         codes = jnp.concatenate([old_codes, codes])
         new_ids = jnp.concatenate([old_ids, new_ids])
         labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
+        if consts is not None:
+            old_consts = index.list_consts.reshape(-1)[old_mask]
+            consts = jnp.concatenate([old_consts, consts])
 
     import numpy as np
 
@@ -399,10 +613,12 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
         centers_rot = jnp.asarray(np.repeat(np.asarray(centers_rot), rep, axis=0))
         if index.codebook_kind == "per_cluster":
             codebooks = jnp.asarray(np.repeat(np.asarray(codebooks), rep, axis=0))
-    buf, idbuf, sizes = _fill_code_lists(codes, new_ids, labels, n_lists, capacity)
+    buf, idbuf, sizes, cbuf = _fill_code_lists(
+        codes, new_ids, labels, n_lists, capacity, consts)
     return dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
-        list_codes=buf, list_ids=idbuf, list_sizes=sizes, split_factor=sf,
+        list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
+        split_factor=sf,
     )
 
 
@@ -487,9 +703,21 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
             # shrinks the contracted axis 16x for exactly that reason.
             codes = index.list_codes[pc]  # (T, pc, cap, pq_dim) gather
             ids = index.list_ids[pc]  # (T, pc, cap)
-            oh = (
-                codes[..., None] == jnp.arange(n_codes, dtype=codes.dtype)
-            )  # (T, pc, cap, pq_dim, n_codes)
+            if index.pq_split:
+                # nibble-split one-hot: stage-1 hit in lanes [0,16), stage-2
+                # in [16,32) — one contraction against the 32-entry LUT sums
+                # LUT1[hi] + LUT2[lo]; the missing cross term rides in
+                # list_consts (added below). Axis pq_dim*32 vs the joint
+                # pq_dim*256: 8x less MXU work for the same 8 code bits.
+                ar16 = jnp.arange(16, dtype=codes.dtype)
+                oh = jnp.concatenate(
+                    [(codes >> 4)[..., None] == ar16,
+                     (codes & 0xF)[..., None] == ar16],
+                    axis=-1)  # (T, pc, cap, pq_dim, 32)
+            else:
+                oh = (
+                    codes[..., None] == jnp.arange(n_codes, dtype=codes.dtype)
+                )  # (T, pc, cap, pq_dim, n_codes)
             # the contraction dtype follows lut_dtype (0/1 one-hot entries
             # are exact in any of them):
             #   float32  — exact LUT values
@@ -520,6 +748,8 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
                     preferred_element_type=jnp.float32,
                 )  # (T, pc, cap)
             scores = scores + bias[:, :, None]
+            if index.pq_split and not inner:
+                scores = scores + index.list_consts[pc]  # (T, pc, cap)
             scores = jnp.where(ids >= 0, scores, -jnp.inf if inner else jnp.inf)
             if keep_mask is not None:
                 from .sample_filter import apply_id_filter
@@ -603,8 +833,10 @@ def save(index: IvfPqIndex, path: str) -> None:
         serialize_scalar(f, index.codebook_kind)
         serialize_scalar(f, index.pq_bits)
         serialize_scalar(f, float(index.split_factor))
+        serialize_scalar(f, bool(index.pq_split))
         for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
-                    index.list_codes, index.list_ids, index.list_sizes):
+                    index.list_codes, index.list_ids, index.list_sizes,
+                    index.list_consts):
             serialize_mdspan(f, arr)
 
 
@@ -616,6 +848,7 @@ def load(path: str, res: Resources | None = None) -> IvfPqIndex:
         codebook_kind = deserialize_scalar(f)
         pq_bits = deserialize_scalar(f)
         split_factor = float(deserialize_scalar(f))
-        arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(7)]
+        pq_split = bool(deserialize_scalar(f))
+        arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(8)]
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
-                      split_factor=split_factor)
+                      split_factor=split_factor, pq_split=pq_split)

@@ -154,10 +154,14 @@ def _pad_pq_lists(index, size: int):
             [index.list_ids, jnp.full((pad, cap), -1, jnp.int32)]),
         list_sizes=jnp.concatenate(
             [index.list_sizes, jnp.zeros((pad,), jnp.int32)]),
+        list_consts=jnp.concatenate(
+            [index.list_consts,
+             jnp.zeros((pad, index.list_consts.shape[1]), jnp.float32)]),
         metric=index.metric,
         codebook_kind=index.codebook_kind,
         pq_bits=index.pq_bits,
         split_factor=index.split_factor,
+        pq_split=index.pq_split,
     )
 
 
@@ -204,11 +208,13 @@ def search_pq(comms: Comms, params, index, queries, k: int,
             "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
             params.lut_dtype)
 
-    def step(centers, centers_rot, codebooks, codes, ids, sizes, q):
+    def step(centers, centers_rot, codebooks, codes, ids, sizes, consts, q):
         shard = IvfPqIndex(
             centers, centers_rot, index.rotation, codebooks, codes, ids, sizes,
+            list_consts=consts,
             metric=index.metric, codebook_kind=index.codebook_kind,
-            pq_bits=index.pq_bits, split_factor=index.split_factor)
+            pq_bits=index.pq_bits, split_factor=index.split_factor,
+            pq_split=index.pq_split)
         d_loc, i_loc = _pq_search(
             shard, q, n_probes, k,
             query_tile=query_tile, probe_chunk=probe_chunk,
@@ -232,11 +238,12 @@ def search_pq(comms: Comms, params, index, queries, k: int,
         shard_along(mesh, axis, index.list_codes),
         shard_along(mesh, axis, index.list_ids),
         shard_along(mesh, axis, index.list_sizes),
+        shard_along(mesh, axis, index.list_consts),
         replicated(mesh, queries),
     )
     fn = comms.shard_map(
         step,
-        in_specs=(P(axis), P(axis), cb_spec, P(axis), P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis), cb_spec, P(axis), P(axis), P(axis), P(axis), P()),
         out_specs=(P(), P()),
     )
     return jax.jit(fn)(*args)

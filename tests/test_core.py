@@ -150,6 +150,32 @@ class TestSerializationVersion:
         ivf_pq.save(pq, p2)
         assert ivf_pq.load(p2).pq_bits == pq.pq_bits
 
+    def test_unchanged_formats_read_previous_version(self, tmp_path, rng):
+        """raft_tpu/3 only changed ivf_pq's layout: ivf_flat files written
+        under the raft_tpu/2 header must still load (no collateral
+        rebuild), while a raft_tpu/2 ivf_pq header must fail."""
+        import jax.numpy as jnp
+        from raft_tpu.core import RaftError
+        from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+        x = jnp.asarray(rng.random((256, 16), "float32"))
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x)
+        p = str(tmp_path / "v2.bin")
+        ivf_flat.save(idx, p)
+        raw = open(p, "rb").read()
+        assert raw.count(b"raft_tpu/3") == 1
+        open(p, "wb").write(raw.replace(b"raft_tpu/3", b"raft_tpu/2"))
+        assert ivf_flat.load(p).metric == idx.metric
+
+        pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, seed=0), x)
+        p2 = str(tmp_path / "pqv2.bin")
+        ivf_pq.save(pq, p2)
+        raw2 = open(p2, "rb").read()
+        i0 = raw2.index(b"raft_tpu/3")
+        open(p2, "wb").write(raw2[:i0] + b"raft_tpu/2" + raw2[i0 + 10:])
+        with pytest.raises(RaftError, match="unsupported ivf_pq index file format"):
+            ivf_pq.load(p2)
+
 
 def test_output_conversion_skips_tracers(rng):
     """@auto_convert_output entry points called inside a user's jit must pass
