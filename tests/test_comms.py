@@ -281,3 +281,106 @@ class TestDocumentedEdgeSemantics:
         bad = np.zeros((72, 1), np.float32)
         with pytest.raises(Exception):
             comms.shard_map(f, in_specs=P("data"), out_specs=P("data"))(bad)
+
+
+class TestFailurePaths:
+    """M5 analogue (reference comms/detail/util.hpp:109-136: sync_stream
+    polls ncclCommGetAsyncError and surfaces status_t::ABORT). The TPU
+    contract, pinned here and documented in docs/using_comms.md:
+
+    - errors raised while TRACING a shard_map body (bad op names, shape
+      mismatches) propagate as ordinary Python exceptions at call time;
+    - a runtime fault in any shard aborts the whole computation and
+      surfaces as an exception no later than ``Comms.sync_stream`` (the
+      block_until_ready analogue of the NCCL abort path);
+    - a cancelled search raises InterruptedException at its next
+      ``synchronize`` cancellation point and leaves the token reusable.
+    """
+
+    def test_trace_time_error_propagates(self, comms):
+        from raft_tpu.core import RaftError
+
+        def bad(x):
+            return comms.allreduce(x, op="nonsense")
+
+        fn = comms.shard_map(bad, in_specs=P("data"), out_specs=P("data"))
+        with pytest.raises(RaftError):
+            fn(np.ones((8, 4), np.float32))
+
+    def test_runtime_fault_surfaces_at_sync(self, comms):
+        """Fault injection: one shard's data trips an in-graph check mid-step
+        (checkify — the sanctioned data-dependent fault surface; a raw host
+        callback raising inside an SPMD execution is NOT recoverable, it
+        aborts the process, which is why the contract routes data-dependent
+        failures through checkify). The error must surface by sync time, and
+        the comms object must remain usable afterwards (the reference aborts
+        the NCCL communicator; XLA tears down just the failed execution)."""
+        import jax.numpy as jnp
+        from jax.experimental import checkify
+
+        def body(x):
+            checkify.check(jnp.all(x < 100.0), "injected shard fault")
+            return comms.allreduce(x)
+
+        fn = comms.shard_map(body, in_specs=P("data"), out_specs=P())
+        checked = checkify.checkify(fn)
+
+        x = np.ones((8, 4), np.float32)
+        x[3] = 1000.0  # only shard 3 faults
+        err, out = checked(x)
+        comms.sync_stream(out)
+        with pytest.raises(Exception, match="injected shard fault"):
+            err.throw()
+        # the session survives a failed execution: same comms, healthy data
+        err, ok = checked(np.ones((8, 4), np.float32))
+        err.throw()
+        comms.sync_stream(ok)
+        np.testing.assert_allclose(np.asarray(ok), np.full(np.asarray(ok).shape, 8.0))
+        assert np.asarray(ok).size > 0
+
+    def test_cancelled_search_raises_and_token_resets(self, comms):
+        """A long multi-batch search cancelled from a controller thread stops
+        at its next synchronize() with InterruptedException (reference:
+        interruptible::synchronize as cancellation point, interruptible.hpp:83;
+        pylibraft test_z_interruptible.py), and the worker thread's token is
+        clean afterwards."""
+        import threading
+
+        from raft_tpu.core import InterruptedException, synchronize
+        from raft_tpu.core.interruptible import cancel, get_token
+        from raft_tpu.neighbors import brute_force
+
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2000, 32), np.float32)
+        qbatches = rng.standard_normal((64, 16, 32), np.float32)
+        state = {"done": 0}
+        ready = threading.Event()
+        go = threading.Event()
+
+        def worker():
+            get_token()
+            state["tid"] = threading.get_ident()
+            ready.set()
+            go.wait()
+            try:
+                for qb in qbatches:
+                    d, i = brute_force.knn(x, qb, 5)
+                    synchronize(d, i)  # cancellation point between batches
+                    state["done"] += 1
+                state["result"] = "completed"
+            except InterruptedException:
+                state["result"] = "cancelled"
+                # token cleared on throw: the thread is immediately reusable
+                d, i = brute_force.knn(x, qbatches[0], 5)
+                synchronize(d, i)
+                state["post_cancel_ok"] = True
+
+        t = threading.Thread(target=worker)
+        t.start()
+        ready.wait()
+        cancel(state["tid"])
+        go.set()
+        t.join(60)
+        assert state["result"] == "cancelled"
+        assert state.get("post_cancel_ok"), "token must reset after the throw"
+        assert state["done"] < len(qbatches)
