@@ -1,0 +1,76 @@
+"""Tuning harness for the fused distance+top-k kernel (VERDICT r2 #3).
+
+Sweeps (qt, nblk) x mode on the flagship config and prints one line per
+combination. Run on real TPU. Protocol matches bench.py: distinct-data
+chained batches inside one jitted program, host-materialized, best of 3.
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.config import enable_compilation_cache
+from raft_tpu.ops.fused_knn import fused_knn
+
+
+def measure(dataset, qsets, k, mode, qt, nblk, n_batches, m):
+    if mode == "xla":
+        from raft_tpu.neighbors.brute_force import _bf_knn
+        from raft_tpu.distance.types import DistanceType
+
+        def searches(qs):
+            return lax.map(lambda q: _bf_knn(
+                dataset, q, k, DistanceType.L2Expanded, 2.0, 1000, 1000), qs)
+    else:
+        def searches(qs):
+            return lax.map(
+                lambda q: fused_knn(dataset, q, k, mode=mode, qt=qt, nblk=nblk), qs)
+
+    f = jax.jit(searches)
+    np.asarray(jax.tree_util.tree_leaves(f(qsets[0]))[0])
+    best = float("inf")
+    for qs in qsets[1:]:
+        t0 = time.perf_counter()
+        out = f(qs)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return n_batches * m / best
+
+
+def main():
+    enable_compilation_cache()
+    import os
+    n, d, m, k = 100_000, 128, 10_000, int(os.environ.get("TUNE_K", "10"))
+    n_batches = 10
+    key = jax.random.key(0)
+    kd, *kq = jax.random.split(key, 5)
+    dataset = jax.random.uniform(kd, (n, d), jnp.float32)
+    qsets = [jax.random.uniform(kk, (n_batches, m, d), jnp.float32)
+             for kk in kq]
+    jax.block_until_ready([dataset] + qsets)
+
+    modes = sys.argv[1].split(",") if len(sys.argv) > 1 else ["f32", "bf16"]
+    qts = [int(x) for x in sys.argv[2].split(",")] if len(sys.argv) > 2 else [256, 512]
+    nblks = [int(x) for x in sys.argv[3].split(",")] if len(sys.argv) > 3 else [4096, 8192]
+
+    flops = 2.0 * n * d  # per query
+    for mode, qt, nblk in itertools.product(modes, qts, nblks):
+        try:
+            qps = measure(dataset, qsets, k, mode, qt, nblk, n_batches, m)
+            print(f"mode={mode:6s} qt={qt:4d} nblk={nblk:5d}  "
+                  f"qps={qps:10.1f}  eff={qps * flops / 1e12:6.2f} TFLOP/s",
+                  flush=True)
+        except Exception as e:
+            print(f"mode={mode:6s} qt={qt:4d} nblk={nblk:5d}  ERROR {str(e)[:120]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

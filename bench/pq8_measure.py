@@ -1,0 +1,108 @@
+"""Measure pq8 nibble-split vs pq4 at 1M on TPU (VERDICT r2 #5).
+
+Rows: pq4x64 (default), pq8x32 split (same code bytes as the reference's
+default pq8 config), pq8x32 joint (the r02 measured-slow path) — bare and
++refine4 — on the LID (SIFT-class) 1M set. Done-bar: split pq8x32 within 2x
+of pq4x64 QPS.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.config import enable_compilation_cache
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.distance.types import DistanceType
+
+
+def make_lid_1m():
+    n, d, m, ncl, idim = 1_000_000, 128, 10_000, 2000, 16
+    kc, kb, kl, kz, kq1, kq2, kq3 = jax.random.split(jax.random.key(7), 7)
+    centers = jax.random.uniform(kc, (ncl, d), jnp.float32) * 10.0
+    bases = jax.random.normal(kb, (ncl, idim, d), jnp.float32)
+    bases = bases / jnp.linalg.norm(bases, axis=-1, keepdims=True)
+
+    def draw(kk_lab, kk_noise, count):
+        labels = jax.random.randint(kk_lab, (count,), 0, ncl)
+        z = 0.5 * jax.random.normal(kk_noise, (count, idim))
+        return centers[labels] + jnp.einsum(
+            "ni,nid->nd", z, bases[labels], precision="highest")
+
+    blk = 50_000
+    kls = jax.random.split(kl, n // blk)
+    kzs = jax.random.split(kz, n // blk)
+    dataset = jnp.concatenate(
+        [draw(kls[i], kzs[i], blk) for i in range(n // blk)])
+    qsets = []
+    for kk in (kq1, kq2, kq3):
+        ka, kb2 = jax.random.split(kk)
+        qsets.append(draw(ka, kb2, m))
+    return dataset, qsets
+
+
+def measure(search_fn, qsets):
+    out = None
+    best = float("inf")
+    np.asarray(jax.tree_util.tree_leaves(search_fn(qsets[0]))[0])
+    for qs in qsets[1:]:
+        t0 = time.perf_counter()
+        out = search_fn(qs)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return qsets[0].shape[0] / best, out
+
+
+def rec(ids, gt):
+    ids = np.asarray(ids)
+    return float(np.mean([len(set(ids[r, :10]) & set(gt[r])) / 10
+                          for r in range(gt.shape[0])]))
+
+
+def main():
+    enable_compilation_cache()
+    print("dataset...", flush=True)
+    dataset, qsets = make_lid_1m()
+    jax.block_until_ready([dataset] + qsets)
+    from raft_tpu.neighbors.brute_force import _bf_knn_fused
+
+    _, gt = _bf_knn_fused(dataset, qsets[-1][:1000], 10,
+                          DistanceType.L2Expanded, "float32", None)
+    gt = np.asarray(gt)
+
+    configs = [
+        ("pq4x64", dict(n_lists=1024, pq_bits=4, pq_dim=64, seed=0)),
+        ("pq8x32-split", dict(n_lists=1024, pq_bits=8, pq_dim=32, seed=0)),
+    ]
+    if "--joint" in sys.argv:
+        configs.append(
+            ("pq8x32-joint", dict(n_lists=1024, pq_bits=8, pq_dim=32,
+                                  pq8_split=False, seed=0)))
+
+    for name, kw in configs:
+        t0 = time.perf_counter()
+        idx = ivf_pq.build(ivf_pq.IndexParams(**kw), dataset)
+        jax.block_until_ready(idx.list_codes)
+        build_s = time.perf_counter() - t0
+        sp = ivf_pq.SearchParams(n_probes=8, lut_dtype="bfloat16")
+
+        qps, out = measure(lambda q: ivf_pq.search(sp, idx, q, 10), qsets)
+        print(f"{name:14s} bare    qps={qps:9.1f} recall={rec(out[1][:1000], gt):.4f} "
+              f"build={build_s:.1f}s", flush=True)
+
+        def searcher(q):
+            _, cand = ivf_pq.search(sp, idx, q, 40)
+            return refine(dataset, q, cand, 10)
+
+        qps_r, out_r = measure(searcher, qsets)
+        print(f"{name:14s} refine4 qps={qps_r:9.1f} recall={rec(out_r[1][:1000], gt):.4f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -135,7 +135,7 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     over dataset rows. ``mode``: "exact" (sort-based TopK) or "approx"
     (TPU PartialReduce, ≥0.99 expected recall, ~2x faster). ``compute``:
     "float32" (bit-accurate distances), "float32x3" (compensated bf16x3
-    contraction, f32-class accuracy at ~1/3 the MXU cost; falls back to
+    contraction, f32-class accuracy at roughly half the MXU cost; falls back to
     "float32" when the fused kernel is not engaged) or "bfloat16"
     (single-pass MXU contraction — same neighbor ordering in all but
     razor-thin margins, several times the GEMM throughput).

@@ -13,27 +13,47 @@ This kernel never materializes scores to HBM.  Grid = (query_tiles,
 dataset_blocks), dataset-block minor.  Each step computes a (QT, NBLK) score
 block in VMEM with one MXU contraction (scores are oriented so *larger is
 better*: ``2 q·y - |y|^2`` for L2, ``q·y`` for inner product), then runs a
-threshold-gated iterative extraction: a block is scanned for candidates only
-while its row-maximum still beats the running k-th best (``tau``), which skips
-most extraction work in later blocks.  Running top-k state lives in VMEM
-scratch that persists across the dataset-block walk; only the final (QT, k)
-values and indices leave the chip.
+threshold-gated iterative extraction: the block is scanned for candidates
+only while its row-maximum still beats the running k-th best (``tau``),
+which skips most extraction work once the running top-k tightens after the
+first few blocks.  Running top-k state lives in VMEM scratch that persists
+across the dataset-block walk; only the final (QT, k) values and indices
+leave the chip.  Bounds padding and sample-filter masks are folded into the
+norms operand (one fused subtract) instead of iota/compare/select passes,
+and bf16-mode operands are cast OUTSIDE the kernel (half the DMA bytes, no
+per-block VPU cast).
 
-Measured on the 100k x 128, k=10, 10k-query batch flagship config (v5e,
-distinct-data chained batches): 217k QPS vs 145k for the XLA GEMM + lax.top_k
-pipeline in the same process, with identical neighbor sets (mode="f32").
+Profiling notes (v5e, 100k x 128, k=10, 10k-query batches; details and
+QPS-with-controls in BASELINE.md "Round-3 fused-kernel engineering notes"):
+- the kernel is VPU-extraction-bound, not MXU-bound: k=1 runs 3.3x faster
+  than k=10, while a 6x MXU-cost swing (f32 HIGHEST vs bf16) moves QPS ~20%;
+- three redesigns measured and REJECTED, kept here as negative results:
+  (a) two-pass with XLA top_k tau between (2nd contraction sweep costs more
+  than the extraction it skips), (b) segmented extraction over per-128-lane
+  maxima (every (QT, NSEG) narrow-lane intermediate costs a vreg relayout;
+  5x slower — keep Pallas hot-loop ops full-lane-width), (c) slice-maxima
+  tau pass seeding the running k-th slot (flat: the running tau is already
+  tight after ~2 blocks; the per-TILE any-row gate, not tau quality, sets
+  the iteration count);
+- the same per-tile-gate insight made qt=128 the default: fewer rows share
+  one extraction loop, so it gates off sooner (+32% f32 / +11% bf16 over
+  qt=256 in the same session).
 
 Modes:
   "f32"   — f32 inputs, Precision.HIGHEST contraction. Exact: neighbor sets
             match the XLA f32 pipeline; within-1-ULP distance ties may order
             differently (score accumulation order differs between kernels).
   "f32x3" — compensated bf16x3 contraction (hi/lo split, three MXU passes),
-            f32-class accuracy at roughly a third of the MXU cost. Neighbor
+            f32-class accuracy at roughly half the MXU cost. Neighbor
             sets match f32 except where two distances differ by < ~1e-6 rel.
   "bf16"  — single-pass bf16 contraction. Fastest; set recall ~0.98 on
             worst-case (uniform) data, higher on clustered data.
 
 Ties: equal scores resolve to the lowest dataset index, matching lax.top_k.
+
+Magnitude limit: scores are ranked against a -3e38 sentinel and masked
+entries ride at ~-3e38, so inputs whose scores approach float32 max (|q·y|
+beyond ~1e37 — feature scales ~1e17+) are out of contract.
 """
 
 from __future__ import annotations
@@ -71,13 +91,15 @@ def shapes_eligible(n: int, d: int, k: int) -> bool:
     return 0 < k <= FUSED_KNN_MAX_K and n >= 4096 and 64 <= d <= 4096
 _NEG = -3.0e38                # finite sentinel: 0 * _NEG must stay finite
 _BIG = 2**30                  # "no index" sentinel
+_MASK_PENALTY = 3.0e38        # added to |y|^2 for padded / filtered-out rows
 
 
 def _extract_topk_ids(v, ids, k):
     """k iterations of (max, argmin-id, mask-by-id) over a small (QT, W) array.
 
-    Ties resolve to the smallest payload id; masking is by id, so duplicate
-    values at different ids are extracted separately.
+    Ties resolve to the smallest payload id; masking is by id, so a value
+    merged twice under the same id (e.g. a running entry re-offered by a
+    later candidate set) is consumed in one step, never duplicated.
     """
     vals, idxs = [], []
     for _ in range(k):
@@ -110,13 +132,9 @@ def _scores(q, y, mode):
                                preferred_element_type=jnp.float32)
 
 
-def _make_kernel(k, nblk, n, qt, mode, l2, has_mask):
-    def kernel(q_ref, y_ref, yn_ref, *rest):
-        if has_mask:
-            keep_ref = rest[0]
-            rest = rest[1:]
-        out_v_ref, out_i_ref, run_v, run_i, s_ref, cand_v, cand_i, go_ref = rest
-
+def _make_kernel(k, nblk, qt, mode, l2):
+    def kernel(q_ref, y_ref, yn_ref, out_v_ref, out_i_ref,
+               run_v, run_i, s_ref, cand_v, cand_i, go_ref):
         j = pl.program_id(1)
         nb = pl.num_programs(1)
 
@@ -125,14 +143,16 @@ def _make_kernel(k, nblk, n, qt, mode, l2, has_mask):
             run_v[:] = jnp.full((qt, 128), _NEG, jnp.float32)
             run_i[:] = jnp.full((qt, 128), _BIG, jnp.int32)
 
-        s = _scores(q_ref[:], y_ref[:], mode)
-        if l2:
-            s = 2.0 * s - yn_ref[:]
-        cols = jax.lax.broadcasted_iota(jnp.int32, (qt, nblk), 1) + j * nblk
-        s = jnp.where(cols < n, s, _NEG)
-        if has_mask:
-            s = jnp.where(keep_ref[:] > 0.0, s, _NEG)
+        dots = _scores(q_ref[:], y_ref[:], mode)
+        # yn carries |y|^2 (L2), the bounds padding penalty AND the sample
+        # filter penalty — one fused subtract instead of iota/compare/select
+        # masking passes. (A segmented-extraction variant that reduced the
+        # block to per-128-lane maxima measured 5x SLOWER: every (qt, nseg)
+        # narrow-lane intermediate costs a vreg relayout on TPU; all hot ops
+        # here deliberately stay (qt, nblk)-wide.)
+        s = (2.0 * dots if l2 else dots) - yn_ref[:]
         s_ref[:] = s
+        cols = jax.lax.broadcasted_iota(jnp.int32, (qt, nblk), 1) + j * nblk
 
         tau = run_v[:, k - 1:k]
         go_ref[0] = 1
@@ -179,29 +199,29 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
     n_pad = -(-n // nblk) * nblk
     m_pad = -(-m // qt) * qt
     d_pad = -(-d // 128) * 128
-    ds = jnp.pad(dataset, ((0, n_pad - n), (0, d_pad - d)))
-    qs = jnp.pad(queries, ((0, m_pad - m), (0, d_pad - d)))
-    ynp = (jnp.pad(yn, (0, n_pad - n)).reshape(1, n_pad)
-           if yn is not None else jnp.zeros((1, n_pad), jnp.float32))
+    # bf16 mode: cast once here, outside the kernel — the per-block VPU cast
+    # inside the kernel was costing more than the narrower MXU pass saved
+    # (measured bf16 SLOWER than f32 with in-kernel casts), and bf16 operands
+    # also halve the per-step DMA bytes
+    io_t = jnp.bfloat16 if mode == "bf16" else jnp.float32
+    ds = jnp.pad(dataset.astype(io_t), ((0, n_pad - n), (0, d_pad - d)))
+    qs = jnp.pad(queries.astype(io_t), ((0, m_pad - m), (0, d_pad - d)))
+    base = yn if yn is not None else jnp.zeros((n,), jnp.float32)
+    if keep is not None:
+        base = base + jnp.where(keep, 0.0, _MASK_PENALTY)
+    ynp = jnp.pad(base, (0, n_pad - n),
+                  constant_values=_MASK_PENALTY).reshape(1, n_pad)
     grid = (m_pad // qt, n_pad // nblk)
-    has_mask = keep is not None
-    kern = _make_kernel(k, nblk, n, qt, mode, l2, has_mask)
 
-    in_specs = [
-        pl.BlockSpec((qt, d_pad), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((nblk, d_pad), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, nblk), lambda i, j: (0, j), memory_space=pltpu.VMEM),
-    ]
-    args = [qs, ds, ynp]
-    if has_mask:
-        in_specs.append(
-            pl.BlockSpec((1, nblk), lambda i, j: (0, j), memory_space=pltpu.VMEM))
-        args.append(jnp.pad(keep.astype(jnp.float32), (0, n_pad - n)).reshape(1, n_pad))
-
+    kern = _make_kernel(k, nblk, qt, mode, l2)
     out_v, out_i = pl.pallas_call(
         kern,
         grid=grid,
-        in_specs=in_specs,
+        in_specs=[
+            pl.BlockSpec((qt, d_pad), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((nblk, d_pad), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nblk), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+        ],
         out_specs=[
             pl.BlockSpec((qt, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((qt, k), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
@@ -213,7 +233,7 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
         scratch_shapes=[
             pltpu.VMEM((qt, 128), jnp.float32),     # running top-k values
             pltpu.VMEM((qt, 128), jnp.int32),       # running top-k ids
-            pltpu.VMEM((qt, nblk), jnp.float32),    # score block
+            pltpu.VMEM((qt, nblk), jnp.float32),    # staged score block
             pltpu.VMEM((qt, 128), jnp.float32),     # block candidates (values)
             pltpu.VMEM((qt, 128), jnp.int32),       # block candidates (ids)
             pltpu.SMEM((1,), jnp.int32),            # extraction gate
@@ -221,12 +241,12 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
-    )(*args)
+    )(qs, ds, ynp)
     return out_v[:m], out_i[:m]
 
 
 def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
-              sqrt=False, qt=256, nblk=4096, interpret=False):
+              sqrt=False, qt=128, nblk=4096, interpret=False):
     """Exact brute-force kNN via the fused Pallas kernel.
 
     ``metric``: "l2" (squared euclidean; ``sqrt=True`` for euclidean) or
@@ -244,12 +264,18 @@ def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
     expects(0 < k <= FUSED_KNN_MAX_K,
             "fused_knn supports k in (0, %d], got %d — use brute_force.knn "
             "for larger k", FUSED_KNN_MAX_K, k)
+    # Mosaic block shapes need 128-lane alignment, and the (qt, nblk) f32
+    # score scratch must fit VMEM alongside the operand blocks
+    expects(nblk % 128 == 0 and 128 <= nblk <= 16384,
+            "nblk must be a multiple of 128 lanes in [128, 16384]")
     l2 = metric == "l2"
     yn = (jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1) if l2 else None)
+    keep = None if keep_mask is None else jnp.asarray(keep_mask).astype(bool)
     # shrink the dataset block if the feature dim would blow the VMEM budget
+    # (in whole 128-lane segments so the invariant above survives the shrink)
     while nblk > 512 and (qt + nblk) * max(d, 128) * 4 + qt * nblk * 4 > 24 * 2**20:
-        nblk //= 2
-    out_v, out_i = _fused_knn_impl(dataset, queries, yn, keep_mask, int(k),
+        nblk = (nblk // 2 // 128) * 128
+    out_v, out_i = _fused_knn_impl(dataset, queries, yn, keep, int(k),
                                    l2, mode, qt, nblk, interpret)
     empty = out_v <= _NEG / 2
     out_i = jnp.where(empty, -1, out_i)
