@@ -206,6 +206,28 @@ class TestDistributedIvfPq:
         assert np.asarray(i).shape == (7, 3)
         assert (np.asarray(i) >= 0).all()
 
+    def test_pq8_split_index_shards(self, comms, rng):
+        """Nibble-split pq8 indexes carry the extra list_consts array; the
+        distributed search must shard it alongside the lists and match the
+        single-device results."""
+        from raft_tpu.neighbors import ivf_pq
+
+        x = rng.random((1024, 16)).astype(np.float32)
+        q = rng.random((20, 16)).astype(np.float32)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8, seed=0), x)
+        assert idx.pq_split
+        sp = ivf_pq.SearchParams(n_probes=idx.n_lists)
+        d_one, i_one = ivf_pq.search(sp, idx, q, 5)
+        d_dist, i_dist = parallel.ivf.search_pq(comms, sp, idx, q, 5)
+        # full probe coverage on both sides -> identical candidate sets AND
+        # identical (consts-dependent) scores
+        np.testing.assert_array_equal(np.sort(np.asarray(i_one), axis=1),
+                                      np.sort(np.asarray(i_dist), axis=1))
+        np.testing.assert_allclose(np.sort(np.asarray(d_one), axis=1),
+                                   np.sort(np.asarray(d_dist), axis=1),
+                                   rtol=1e-5)
+
 
 class TestDistributedCagra:
     def test_matches_exact(self, comms, rng):

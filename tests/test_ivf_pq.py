@@ -278,6 +278,21 @@ class TestPq8Split:
         np.testing.assert_allclose(consts[l, p], np.asarray(idx.list_consts)[l1, p1],
                                    rtol=1e-6)
 
+    def test_per_cluster_split(self, data):
+        """per_cluster codebooks x nibble-split: stage training on the pooled
+        per-cluster subvectors and the per-cluster cross-consts gather
+        (_pq_cross_consts labels branch) compose with the split scan."""
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=16, pq_dim=8, pq_bits=8, codebook_kind="per_cluster",
+            seed=0), x)
+        assert idx.pq_split
+        assert idx.codebooks.shape == (idx.n_lists, 32, 4)
+        assert idx.list_consts.shape == (idx.n_lists, idx.capacity)
+        _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=idx.n_lists), idx, q, k=10)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        assert _recall(np.asarray(i), true_i) > 0.4
+
     def test_roundtrip_split(self, tmp_path, data):
         x, q = data
         idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8, seed=0), x)
