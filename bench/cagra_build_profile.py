@@ -1,0 +1,102 @@
+"""Profile the CAGRA 1M build (VERDICT r3 #3): where do the ~440 s go?
+
+Replays cagra.build's exact pipeline (bench.py protocol: isotropic 1M x 128,
+default IndexParams) with per-phase wall timers: the internal IVF-PQ build,
+each knn-graph chunk (separating the first, compile-heavy, call from the
+steady state), and optimize (prune + reverse merge). Run on the TPU host:
+
+    python bench/cagra_build_profile.py [--n 1000000] [--chunk 16384]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--chunk", type=int, default=16384)
+    ap.add_argument("--probes", type=int, default=8)
+    args = ap.parse_args()
+
+    from raft_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+
+    import bench as drv
+    from raft_tpu.core.resources import default_resources
+    from raft_tpu.distance.types import resolve_metric
+    from raft_tpu.neighbors import cagra, ivf_pq
+    from raft_tpu.neighbors.cagra import _build_chunk_step, optimize
+
+    t_all = time.perf_counter()
+    dataset, _ = drv._make_1m()
+    if args.n < dataset.shape[0]:
+        dataset = dataset[:args.n]
+    jax.block_until_ready(dataset)
+    n, d = dataset.shape
+    print(f"dataset {n}x{d} ready +{time.perf_counter()-t_all:.1f}s",
+          flush=True)
+
+    params = cagra.IndexParams(build_chunk=args.chunk,
+                               build_n_probes=args.probes)
+    res = default_resources()
+    k = params.intermediate_graph_degree
+    gpu_top_k = min(int(k * params.refine_rate), n - 1)
+    n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
+    pq_bits = params.build_pq_bits or (
+        4 if ivf_pq._default_pq_dim(d, 8) >= 32 else 8)
+
+    t0 = time.perf_counter()
+    pq = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=min(n_lists, n // 4 if n >= 32 else n),
+                           metric=params.metric, pq_bits=pq_bits,
+                           seed=params.seed), dataset, res=res)
+    jax.block_until_ready(pq.list_codes)
+    t_pq = time.perf_counter() - t0
+    print(f"phase ivf_pq.build: {t_pq:.1f}s (n_lists={pq.n_lists}, "
+          f"pq_bits={pq_bits}, cap={pq.capacity})", flush=True)
+
+    mt = resolve_metric(params.metric)
+    chunk = args.chunk
+    parts = []
+    chunk_times = []
+    for s in range(0, n, chunk):
+        xb = dataset[s:s + chunk]
+        rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
+        t0 = time.perf_counter()
+        out = _build_chunk_step(dataset, pq, xb, rows, int(params.build_n_probes),
+                                int(gpu_top_k), int(k), mt,
+                                int(res.workspace_bytes))
+        jax.block_until_ready(out)
+        chunk_times.append(time.perf_counter() - t0)
+        parts.append(out)
+        if len(chunk_times) in (1, 2, 3):
+            print(f"  chunk {len(chunk_times)}: {chunk_times[-1]:.2f}s",
+                  flush=True)
+    knn_graph = jnp.concatenate(parts, axis=0)
+    steady = sorted(chunk_times[1:])[len(chunk_times) // 2] if len(
+        chunk_times) > 1 else chunk_times[0]
+    print(f"phase knn_graph: {sum(chunk_times):.1f}s over "
+          f"{len(chunk_times)} chunks (first={chunk_times[0]:.2f}s, "
+          f"median-steady={steady:.2f}s, sum-steady="
+          f"{sum(chunk_times[1:]):.1f}s)", flush=True)
+
+    t0 = time.perf_counter()
+    graph = optimize(knn_graph, params.graph_degree, res=res)
+    jax.block_until_ready(graph)
+    print(f"phase optimize: {time.perf_counter()-t0:.1f}s", flush=True)
+    print(f"TOTAL build-equivalent: {time.perf_counter()-t_all:.1f}s "
+          f"(incl. dataset gen)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

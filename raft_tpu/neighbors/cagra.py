@@ -35,10 +35,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.errors import expects
+from ..core.logger import logger
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.types import DistanceType, resolve_metric
+from ..random.rng import as_key
 from . import ivf_pq as ivf_pq_mod
 from .refine import refine
 
@@ -61,7 +63,19 @@ class IndexParams:
     # reference always uses 8; its smem LUT is bits-insensitive).
     build_pq_bits: int = 0
     build_n_lists: int = 0  # 0 → sqrt(n) heuristic
-    build_n_probes: int = 32
+    # probes for the self-search that builds the knn graph. The r04 profile
+    # (bench/cagra_build_profile.py) put 98% of the 445 s 1M build in this
+    # search, scaling linearly in probes — and a full-build A/B measured
+    # p=8 vs p=32 recall IDENTICAL to 4 decimals (0.9714 @ itopk32 /
+    # 0.9964 @ itopk64) at 122.6 s vs 445 s on clustered data: a dataset
+    # point's top-64 neighbors live in its home + adjacent lists. On
+    # small/uniform data the same drop costs real graph quality (0.80 →
+    # 0.63 edge recall at 4k x 24 uniform), so 0 (default) = MEASURED auto:
+    # chunk 0 is built at p=32 and p=8 and the cheap setting is kept for
+    # the remaining chunks only when its refined edge lists overlap the
+    # wide ones >= 95% (escalating to 16, then 32). Explicit values are
+    # honored as-is. (BASELINE.md "Round-4 CAGRA build".)
+    build_n_probes: int = 0
     # gpu_top_k multiplier (ref cagra_build.cuh:99 defaults 2.0 against pq8);
     # 3.0 compensates pq4's coarser candidate ordering — the wider exact
     # refine pool costs far less than pq8's 10x-slower LUT scan
@@ -97,6 +111,13 @@ class SearchParams:
     # recall, 16384 → 0.973 at identical QPS — the GEMM is not the hop
     # loop's bottleneck. 0 → plain random entries (reference behavior).
     seed_pool: int = 16384
+    # RNG seed (int / RngState / raw key) for the seed-pool draw (ref
+    # search_params :118 rand_xor_mask). Determinism contract: the same
+    # (seed, index, queries, params) always searches the same sampled pool,
+    # so results are bitwise reproducible; vary the seed to decorrelate the
+    # entry-coverage ceiling across calls (VERDICT r3 weak #3 — a fixed
+    # key tied every search to one 16384-point draw).
+    seed: int = 0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -162,13 +183,55 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     # 20+ min), so 62 chunks must cost 62 round trips, not ~400.
     chunk = max(int(params.build_chunk), 1)
     mt = resolve_metric(params.metric)
-    parts = []
-    for s in range(0, n, chunk):
+
+    def chunk_step(s, probes):
         xb = x[s:s + chunk]
         rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
-        parts.append(_build_chunk_step(
-            x, pq, xb, rows, int(params.build_n_probes), int(gpu_top_k),
-            int(k), mt, int(res.workspace_bytes)))
+        return _build_chunk_step(x, pq, xb, rows, probes, int(gpu_top_k),
+                                 int(k), mt, int(res.workspace_bytes))
+
+    probes = int(params.build_n_probes)
+    parts = []
+    if probes == 0:
+        # measured auto (r04, BASELINE.md "Round-4 CAGRA build"): the
+        # self-search is 98% of the build and linear in probes, but how few
+        # probes preserve the graph depends on the data (clustered 1M: p=8
+        # == p=32 to 4 decimals of search recall; uniform 4k: p=8 costs
+        # 0.17 edge recall). So pay p=32 once on chunk 0 — whose edges are
+        # kept, nothing is wasted — and adopt the cheapest of p=8/16 whose
+        # refined edge lists overlap it >= 95% for the remaining chunks.
+        import numpy as np
+
+        probes = 32
+        wide = chunk_step(0, 32)
+        parts.append(wide)
+        if n > chunk:  # autotune only pays when more chunks follow
+            # trials run on a 2048-row sub-chunk (the decision sample), not
+            # the full chunk — the trial search itself is the cost being
+            # tuned away
+            t_rows = min(2048, chunk, n)
+            xt = x[:t_rows]
+            rt = jnp.arange(t_rows, dtype=jnp.int32)
+            wide_h = np.asarray(wide)[:t_rows]
+            for p_try in (8, 16):
+                trial = np.asarray(_build_chunk_step(
+                    x, pq, xt, rt, p_try, int(gpu_top_k), int(k), mt,
+                    int(res.workspace_bytes)))
+                overlap = np.mean([
+                    len(set(a) & set(b)) / len(a)
+                    for a, b in zip(trial.tolist(), wide_h.tolist())])
+                if overlap >= 0.95:
+                    probes = p_try
+                    logger.info(
+                        "cagra build_n_probes auto: p=%d edge lists overlap "
+                        "p=32 at %.3f — using %d probes for the remaining "
+                        "chunks", p_try, overlap, p_try)
+                    break
+            else:
+                logger.info("cagra build_n_probes auto: keeping 32 probes "
+                            "(cheaper settings overlapped < 0.95)")
+    for s in range(chunk if parts else 0, n, chunk):
+        parts.append(chunk_step(s, probes))
     return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
 
 
@@ -317,8 +380,9 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
     jax.jit,
     static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out", "seed_pool"),
 )
-def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
-                  search_width: int, sqrt_out: bool, seed_pool: int = 16384):
+def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
+                  max_iter: int, search_width: int, sqrt_out: bool,
+                  seed_pool: int = 16384):
     n, d = index.dataset.shape
     m = queries.shape[0]
     deg = index.graph_degree
@@ -336,7 +400,6 @@ def _cagra_search(index: CagraIndex, queries, k: int, itopk: int, max_iter: int,
         return dn2[ids] - 2.0 * dots  # + ‖q‖² added at the end
 
     # ---- init beam: entry points (ref: search_plan random_samplings) ----
-    key = jax.random.key(0)
     n_init = min(max(itopk, exp_per_hop), n)
     pool = min(int(seed_pool), n)  # small datasets: score every point
     if pool > n_init:
@@ -434,7 +497,8 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     itopk = params.itopk_size
     max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
-    return _cagra_search(index, queries, int(k), int(itopk), int(max_iter),
+    return _cagra_search(index, queries, as_key(params.seed), int(k),
+                         int(itopk), int(max_iter),
                          int(params.search_width), sqrt_out, int(params.seed_pool))
 
 

@@ -106,6 +106,23 @@ class SearchParams:
     # (query, probe) with a symmetric scale and accumulates in int32 on the
     # MXU's int8 path, halving LUT operand bytes again vs bf16)
     lut_dtype: str = "float32"
+    # scan formulation for Σ_s LUT[s, code_s] (ref compute_similarity's smem
+    # gather, ivf_pq_compute_similarity-inl.cuh — a TPU has no smem gather,
+    # so the gather is re-expressed):
+    #   "onehot" — one-hot MXU contraction (the r01+ path, and what "auto"
+    #              picks): a (T, pc, cap, pq_dim*K) operand XLA fuses the
+    #              codes gather + compare + cast into, streamed at HBM rate.
+    #   "pallas" — fused Pallas kernel (ops/pq_scan.py): LUT resident in
+    #              VMEM, codes streamed as int8 planes, tpu.dynamic_gather
+    #              (the hardware LUT16 shuffle) + MXU lane reduction. TPU
+    #              (or interpret) only; 16-wide stages (pq4 / split pq8).
+    #              Measured 0.73x the onehot path at 1M — issue-overhead
+    #              bound (BASELINE.md "Round-4 PQ scan study") — kept as an
+    #              option and as the starting point for a grouped scan.
+    #   "select" — the compare+select chain left to XLA: 0.55x onehot at 1M
+    #              (XLA materializes each of the 16 passes); reference impl.
+    #   "auto"   — onehot (fastest measured everywhere).
+    scan_impl: str = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -305,7 +322,10 @@ def _per_cluster_gain(resid, labels, codebooks, split: bool, key, n_iters: int,
     import numpy as np
 
     n, pq_dim, pq_len = resid.shape
-    cb_ps = codebooks[:, :16, :] if split else codebooks  # (pq_dim, K, L)
+    # split codebooks are compared COMPOSED (the effective 256-entry Minkowski
+    # sum), not by their 16-entry stage-1 proxy — the auto decision must weigh
+    # what search actually scores against (ADVICE r3)
+    cb_ps = _composed_codebooks(codebooks) if split else codebooks  # (pq_dim, K, L)
     k_codes = cb_ps.shape[1]
     counts = np.bincount(np.asarray(labels), minlength=1)
     trial = np.argsort(counts)[::-1][:n_trial]
@@ -328,9 +348,14 @@ def _per_cluster_gain(resid, labels, codebooks, split: bool, key, n_iters: int,
 
     err_ps = jnp.sum(jax.vmap(ps_err)(rv))
 
-    # trial per-cluster codebooks: pool subvectors across subspaces per cluster
+    # trial per-cluster codebooks: pool subvectors across subspaces per
+    # cluster; split trials train the same two-stage quantizer and compose,
+    # so both error terms measure 256-entry effective codebooks
     flat = rv.reshape(len(trial), cap * pq_dim, pq_len)
-    cb_pc = _train_codebooks_batched(flat, key, k_codes, n_iters)
+    if split:
+        cb_pc = _composed_codebooks(_train_split_codebooks(flat, key, n_iters))
+    else:
+        cb_pc = _train_codebooks_batched(flat, key, k_codes, n_iters)
 
     def pc_err(v, c):  # (cap*pq_dim, L), (K, L)
         d = (jnp.sum(c * c, axis=-1)[None]
@@ -406,6 +431,35 @@ def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
 
     codes = lax.map(body, (rt, lt))
     return codes.reshape(num * tile, -1)[:n]
+
+
+def _select_scores(codes, lut, split: bool):
+    """Σ_s LUT[s, code_s] as 16 compare+select passes per stage — the VPU
+    re-expression of the reference's smem LUT gather (the TPU analogue of
+    ScaNN's SIMD LUT16 shuffle). ``codes`` (..., cap, pq_dim) uint8;
+    ``lut`` (..., pq_dim, K) with K=16 (pq4) or K=32 (nibble-split pq8:
+    stage-1 entries in [..., :16], stage-2 in [..., 16:]).
+
+    Unlike the one-hot MXU contraction this never materializes a
+    (..., cap, pq_dim*K) operand in HBM and never runs an N=1 batched matvec
+    (~1/128 MXU utilization); XLA fuses the compare/select/add chain straight
+    into the score reduction. Accumulation is f32 regardless of the LUT
+    dtype (a bf16 LUT still halves nothing here — entries are register
+    values — but keeps the rounding semantics of the one-hot path).
+    """
+    lutf = lut.astype(jnp.float32)
+    acc = jnp.zeros(codes.shape, jnp.float32)  # (..., cap, pq_dim)
+    if split:
+        hi, lo = codes >> 4, codes & 0xF
+        for kk in range(16):
+            k8 = jnp.uint8(kk)
+            acc = acc + jnp.where(hi == k8, lutf[..., None, :, kk], 0.0)
+            acc = acc + jnp.where(lo == k8, lutf[..., None, :, 16 + kk], 0.0)
+    else:
+        for kk in range(lut.shape[-1]):
+            acc = acc + jnp.where(codes == jnp.uint8(kk),
+                                  lutf[..., None, :, kk], 0.0)
+    return jnp.sum(acc, axis=-1)
 
 
 def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int, consts=None):
@@ -551,11 +605,58 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     return extend(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
 
 
+def resolve_scan_impl(params: SearchParams, index: IvfPqIndex, n_codes: int) -> str:
+    """Validate + resolve ``params.scan_impl`` (shared by the single-chip and
+    distributed searches, so both fail with the same clear errors instead of
+    opaque trace-time ones)."""
+    expects(params.scan_impl in ("auto", "onehot", "select", "pallas"),
+            "scan_impl must be 'auto', 'onehot', 'select' or 'pallas', got %r",
+            params.scan_impl)
+    scan_impl = params.scan_impl
+    narrow_stages = index.pq_split or n_codes <= 16
+    if scan_impl == "auto":
+        # the one-hot MXU contraction everywhere: the r04 kernel study
+        # (BASELINE.md "Round-4 PQ scan study") measured every alternative
+        # slower at 1M — XLA "select" chain 0.55x, Pallas compare+select
+        # 0.7x, Pallas tpu.dynamic_gather (the hardware LUT16) 0.73x — the
+        # one-hot path fuses gather+compare+cast into the contraction and
+        # saturates HBM, which nothing code-streaming beat in-search
+        scan_impl = "onehot"
+    expects(scan_impl == "onehot" or narrow_stages,
+            "scan_impl=%r needs 16-wide LUT stages (pq_bits=4 or "
+            "nibble-split pq8); this index has %d-entry codebooks",
+            scan_impl, n_codes)
+    expects(scan_impl == "onehot" or params.lut_dtype != "int8",
+            "lut_dtype='int8' is a one-hot-contraction optimization; use "
+            "scan_impl='onehot' (or lut_dtype float32/bfloat16) instead")
+    if scan_impl == "pallas":
+        from ..ops.pq_scan import pq_scan_backend_ok
+
+        ok, _ = pq_scan_backend_ok()
+        expects(ok, "scan_impl='pallas' needs a TPU backend (or "
+                "RAFT_TPU_PQ_SCAN_INTERPRET=1 to opt into interpret mode "
+                "for tests)")
+    return scan_impl
+
+
+def _check_split_consts(index: IvfPqIndex) -> None:
+    """A pq_split L2 index must carry per-vector cross-term constants; a
+    hand-constructed index without them would otherwise fail deep inside the
+    jitted scan with an opaque broadcast error (ADVICE r3)."""
+    if (index.pq_split and index.metric != DistanceType.InnerProduct
+            and index.capacity > 0):
+        expects(index.list_consts.shape == index.list_ids.shape,
+                "pq_split L2 index needs list_consts of shape %s (per-vector "
+                "cross terms), got %s — build via build()/extend(), which "
+                "populate them", index.list_ids.shape, index.list_consts.shape)
+
+
 def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None,
            split_factor: float | None = None) -> IvfPqIndex:
     """Encode + append vectors (reference: ivf_pq::extend; encode path
     process_and_fill_codes, detail/ivf_pq_build.cuh)."""
     res = res or default_resources()
+    _check_split_consts(index)
     x = jnp.asarray(new_vectors)
     expects(x.ndim == 2 and x.shape[1] == index.dim, "vector dim mismatch")
     n_new = x.shape[0]
@@ -625,11 +726,11 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
 @functools.partial(
     jax.jit,
     static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric",
-                     "codebook_kind", "lut_dtype"),
+                     "codebook_kind", "lut_dtype", "scan_impl"),
 )
 def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: int,
                probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_dtype: str,
-               keep_mask=None):
+               keep_mask=None, scan_impl: str = "onehot"):
     m, d = queries.shape
     qf = queries.astype(jnp.float32)
     inner = metric == DistanceType.InnerProduct
@@ -703,7 +804,31 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
             # shrinks the contracted axis 16x for exactly that reason.
             codes = index.list_codes[pc]  # (T, pc, cap, pq_dim) gather
             ids = index.list_ids[pc]  # (T, pc, cap)
-            if index.pq_split:
+            if scan_impl == "pallas":
+                # fused Pallas sweep (ops/pq_scan.py): LUT resident in VMEM,
+                # codes streamed as int8 planes, no one-hot operand at all
+                from ..ops.pq_scan import pq_lut_scan, pq_scan_backend_ok
+
+                _, interp = pq_scan_backend_ok()
+                ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+                lut_t = jnp.swapaxes(lut, 2, 3).reshape(
+                    query_tile * probe_chunk, n_codes, pq_dim).astype(ct)
+                cflat = codes.reshape(query_tile * probe_chunk, cap, pq_dim)
+                if index.pq_split:
+                    scores = pq_lut_scan(
+                        (cflat >> 4).astype(jnp.int8), lut_t,
+                        codes_lo=(cflat & 0xF).astype(jnp.int8),
+                        interpret=interp)
+                else:
+                    scores = pq_lut_scan(cflat.astype(jnp.int8), lut_t,
+                                         interpret=interp)
+                scores = scores.reshape(query_tile, probe_chunk, cap)
+            elif scan_impl == "select":
+                # compare+select gather (see _select_scores): bf16 rounds the
+                # LUT like the one-hot bf16 mode; accumulation stays f32
+                ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+                scores = _select_scores(codes, lut.astype(ct), index.pq_split)
+            elif index.pq_split:
                 # nibble-split one-hot: stage-1 hit in lanes [0,16), stage-2
                 # in [16,32) — one contraction against the 32-entry LUT sums
                 # LUT1[hi] + LUT2[lo]; the missing cross term rides in
@@ -718,35 +843,37 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
                 oh = (
                     codes[..., None] == jnp.arange(n_codes, dtype=codes.dtype)
                 )  # (T, pc, cap, pq_dim, n_codes)
-            # the contraction dtype follows lut_dtype (0/1 one-hot entries
-            # are exact in any of them):
-            #   float32  — exact LUT values
-            #   bfloat16 — LUT rounded to ~2^-8 relative, half the bytes
-            #   int8     — LUT quantized per (query, probe) with a symmetric
-            #              scale (the reference's fp8 smem LUT analogue,
-            #              detail/fp_8bit.cuh); int32 accumulation on the
-            #              int8 MXU path, quarter the operand bytes
-            ohf = oh.reshape(query_tile, probe_chunk, cap, pq_dim * n_codes)
-            lutf = lut.reshape(query_tile, probe_chunk, pq_dim * n_codes)
-            if lut_dtype not in ("float32", "bfloat16", "int8"):
-                raise ValueError(f"unknown lut_dtype {lut_dtype!r}")
-            if lut_dtype == "int8":
-                amax = jnp.max(jnp.abs(lutf), axis=2, keepdims=True)  # (T,pc,1)
-                scale = jnp.maximum(amax, 1e-30) / 127.0
-                lut_q = jnp.clip(jnp.round(lutf / scale), -127, 127).astype(jnp.int8)
-                acc = lax.dot_general(
-                    ohf.astype(jnp.int8), lut_q,
-                    (((3,), (2,)), ((0, 1), (0, 1))),
-                    preferred_element_type=jnp.int32,
-                )  # (T, pc, cap) int32
-                scores = acc.astype(jnp.float32) * scale
-            else:
-                ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
-                scores = lax.dot_general(
-                    ohf.astype(ct), lutf.astype(ct),
-                    (((3,), (2,)), ((0, 1), (0, 1))),
-                    preferred_element_type=jnp.float32,
-                )  # (T, pc, cap)
+            if scan_impl == "onehot":
+                # the contraction dtype follows lut_dtype (0/1 one-hot entries
+                # are exact in any of them):
+                #   float32  — exact LUT values
+                #   bfloat16 — LUT rounded to ~2^-8 relative, half the bytes
+                #   int8     — LUT quantized per (query, probe) with a
+                #              symmetric scale (the reference's fp8 smem LUT
+                #              analogue, detail/fp_8bit.cuh); int32
+                #              accumulation on the int8 MXU path, quarter the
+                #              operand bytes
+                ohf = oh.reshape(query_tile, probe_chunk, cap, pq_dim * n_codes)
+                lutf = lut.reshape(query_tile, probe_chunk, pq_dim * n_codes)
+                if lut_dtype not in ("float32", "bfloat16", "int8"):
+                    raise ValueError(f"unknown lut_dtype {lut_dtype!r}")
+                if lut_dtype == "int8":
+                    amax = jnp.max(jnp.abs(lutf), axis=2, keepdims=True)  # (T,pc,1)
+                    scale = jnp.maximum(amax, 1e-30) / 127.0
+                    lut_q = jnp.clip(jnp.round(lutf / scale), -127, 127).astype(jnp.int8)
+                    acc = lax.dot_general(
+                        ohf.astype(jnp.int8), lut_q,
+                        (((3,), (2,)), ((0, 1), (0, 1))),
+                        preferred_element_type=jnp.int32,
+                    )  # (T, pc, cap) int32
+                    scores = acc.astype(jnp.float32) * scale
+                else:
+                    ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+                    scores = lax.dot_general(
+                        ohf.astype(ct), lutf.astype(ct),
+                        (((3,), (2,)), ((0, 1), (0, 1))),
+                        preferred_element_type=jnp.float32,
+                    )  # (T, pc, cap)
             scores = scores + bias[:, :, None]
             if index.pq_split and not inner:
                 scores = scores + index.list_consts[pc]  # (T, pc, cap)
@@ -795,6 +922,7 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
     expects(index.capacity > 0, "index is empty")
+    _check_split_consts(index)
     if not isinstance(index.list_sizes, jax.core.Tracer):
         expects(index.size > 0, "index is empty")
     n_probes = min(params.n_probes, index.n_lists)
@@ -805,6 +933,7 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
             "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
             params.lut_dtype)
     n_codes = index.codebooks.shape[-2]
+    scan_impl = resolve_scan_impl(params, index, n_codes)
     query_tile, probe_chunk = plan_search_tiles(
         m, n_probes, int(k), index.capacity,
         bytes_per_probe_row=pq_scan_bytes_per_probe_row(
@@ -821,7 +950,7 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     return _pq_search(
         index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
         index.codebook_kind, params.lut_dtype,
-        keep_mask,
+        keep_mask, scan_impl=scan_impl,
     )
 
 

@@ -208,7 +208,12 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
     qs = jnp.pad(queries.astype(io_t), ((0, m_pad - m), (0, d_pad - d)))
     base = yn if yn is not None else jnp.zeros((n,), jnp.float32)
     if keep is not None:
-        base = base + jnp.where(keep, 0.0, _MASK_PENALTY)
+        # clamp: |y|^2 + penalty would overflow f32 to +inf for rows with
+        # |y|^2 beyond ~4e37, and an inf norm turns the kernel's masked
+        # arithmetic into NaN — pin filtered rows at the finite sentinel so
+        # masking stays magnitude-independent
+        base = jnp.minimum(base + jnp.where(keep, 0.0, _MASK_PENALTY),
+                           _MASK_PENALTY)
     ynp = jnp.pad(base, (0, n_pad - n),
                   constant_values=_MASK_PENALTY).reshape(1, n_pad)
     grid = (m_pad // qt, n_pad // nblk)

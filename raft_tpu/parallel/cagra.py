@@ -27,6 +27,7 @@ from ..comms.comms import Comms, replicated, shard_along
 from ..core.errors import expects
 from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
+from ..random.rng import as_key
 from ..neighbors.cagra import (CagraIndex, IndexParams, SearchParams, _cagra_search,
                                resolve_max_iterations)
 from ..neighbors.cagra import build as build_single
@@ -106,8 +107,9 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
 
     def step(data, graph, q):
         shard = CagraIndex(dataset=data[0], graph=graph[0], metric=index.metric)
-        d_loc, i_loc = _cagra_search(shard, q, k, itopk, max_iter,
-                                     int(params.search_width), sqrt_out, seed_pool)
+        d_loc, i_loc = _cagra_search(shard, q, as_key(params.seed), k, itopk,
+                                     max_iter, int(params.search_width),
+                                     sqrt_out, seed_pool)
         i_glob = jnp.where(i_loc >= 0,
                            i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
         d_all = comms.allgather(d_loc)

@@ -207,6 +207,13 @@ def search_pq(comms: Comms, params, index, queries, k: int,
     expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
             "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
             params.lut_dtype)
+    # same validation + resolution as the single-chip search (auto = the
+    # one-hot contraction, fastest measured — BASELINE.md r04 scan study),
+    # and the same clear error for a pq_split index missing its cross terms
+    from ..neighbors.ivf_pq import _check_split_consts, resolve_scan_impl
+
+    _check_split_consts(index)
+    scan_impl = resolve_scan_impl(params, index, n_codes)
 
     def step(centers, centers_rot, codebooks, codes, ids, sizes, consts, q):
         shard = IvfPqIndex(
@@ -219,7 +226,7 @@ def search_pq(comms: Comms, params, index, queries, k: int,
             shard, q, n_probes, k,
             query_tile=query_tile, probe_chunk=probe_chunk,
             metric=index.metric, codebook_kind=index.codebook_kind,
-            lut_dtype=params.lut_dtype)
+            lut_dtype=params.lut_dtype, scan_impl=scan_impl)
         d_all = comms.allgather(d_loc)
         i_all = comms.allgather(i_loc)
         m = q.shape[0]

@@ -3,15 +3,19 @@
 The reference leaves multi-GPU kNN to users composing raft::comms + per-shard
 search + knn_merge_parts (SURVEY.md §5 "long-context" entry;
 docs/source/using_comms.rst). Here it is a first-class driver: the dataset is
-row-sharded over a mesh axis, every chip runs the tiled brute-force search on
-its shard (MXU GEMM + fused top-k), and one all_gather + select_k merge
-produces the global result — candidates ride ICI, never the full distance
-matrix.
+row-sharded over a mesh axis, every chip runs the local brute-force search on
+its shard — the fused Pallas distance+top-k kernel (ops/fused_knn.py) when
+the per-shard shapes qualify on TPU, the XLA GEMM+top_k pipeline otherwise —
+and one all_gather + select_k merge produces the global result (the
+reference's knn_merge_parts pattern, detail/knn_merge_parts.cuh): candidates
+ride ICI, never the full distance matrix.
+
+Non-divisible datasets self-pad: the tail shard is filled with masked rows
+(the same trick the reference uses for padded inverted lists), so callers
+never see the shard-divisibility invariant.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -21,44 +25,76 @@ from ..comms.comms import Comms, replicated, shard_along
 from ..core.errors import expects
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
-from ..neighbors.brute_force import _bf_knn
+from ..neighbors.brute_force import _bf_knn, _bf_knn_fused, _fused_eligible
 
 __all__ = ["knn"]
 
 
 def knn(comms: Comms, dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
-        tile: int = 2048, inner_tile: int = 512):
+        tile: int = 2048, inner_tile: int = 512, compute: str = "float32"):
     """Distributed exact kNN (multi-chip analogue of brute_force.knn).
 
-    ``dataset`` is sharded along ``comms.axis`` (row-wise, equal shards —
-    pad the tail shard like the reference pads inverted lists); ``queries``
-    are replicated. Returns replicated (distances (m, k), global indices).
+    ``dataset`` is sharded along ``comms.axis`` (row-wise; a non-divisible
+    row count is padded with masked rows internally); ``queries`` are
+    replicated. ``compute`` selects the local kernel's contraction mode
+    ("float32" | "float32x3" | "bfloat16", as brute_force.knn). Returns
+    replicated (distances (m, k), global indices). ``k`` must fit one shard's
+    rows (the per-shard candidate width of the merge).
     """
     dataset = jnp.asarray(dataset)
     queries = jnp.asarray(queries)
-    n = dataset.shape[0]
+    n, d = dataset.shape
     size = comms.size()
-    expects(n % size == 0, "dataset rows (%d) must divide the mesh axis (%d); pad first", n, size)
-    shard_rows = n // size
-    expects(0 < k <= shard_rows, "k must be <= per-shard rows")
+    n_pad = -(-n // size) * size
+    shard_rows = n_pad // size
+    expects(0 < k <= shard_rows,
+            "k=%d must be <= per-shard rows (%d rows over %d shards)",
+            k, shard_rows, size)
     mt = resolve_metric(metric)
     select_min = mt != DistanceType.InnerProduct
+    keep = None
+    if n_pad != n:
+        dataset = jnp.pad(dataset, ((0, n_pad - n), (0, 0)))
+        keep = jnp.arange(n_pad) < n
+    use_fused = _fused_eligible(mt, int(k), shard_rows, d, "exact", compute)
 
-    def step(x_shard, q):
-        # local exact search on this chip's rows
-        d_loc, i_loc = _bf_knn(x_shard, q, k, mt, metric_arg,
-                               min(tile, q.shape[0]), inner_tile)
-        # shard-local → global ids
-        i_glob = i_loc + comms.rank().astype(jnp.int32) * shard_rows
+    def local_search(x_shard, q, keep_shard):
+        if use_fused:
+            return _bf_knn_fused(x_shard, q, k, mt, compute, keep_shard)
+        comp = "float32" if compute == "float32x3" else compute
+        return _bf_knn(x_shard, q, k, mt, metric_arg,
+                       min(tile, q.shape[0]), inner_tile, keep_shard,
+                       compute=comp)
+
+    def merge(d_loc, i_loc, m):
+        # shard-local → global ids; -1 (masked-slot) sentinels stay -1
+        i_glob = jnp.where(i_loc >= 0,
+                           i_loc + comms.rank().astype(jnp.int32) * shard_rows,
+                           -1)
         # candidates ride ICI: (size, m, k) each
         d_all = comms.allgather(d_loc)
         i_all = comms.allgather(i_glob)
-        m = q.shape[0]
         d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
         i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
         return _select_k(d_flat, i_flat, k, select_min)
 
     x_sharded = shard_along(comms.mesh, comms.axis, dataset)
     q_repl = replicated(comms.mesh, queries)
-    fn = comms.shard_map(step, in_specs=(P(comms.axis), P()), out_specs=(P(), P()))
-    return jax.jit(fn)(x_sharded, q_repl)
+    if keep is None:
+        def step(x_shard, q):
+            d_loc, i_loc = local_search(x_shard, q, None)
+            return merge(d_loc, i_loc, q.shape[0])
+
+        fn = comms.shard_map(step, in_specs=(P(comms.axis), P()),
+                             out_specs=(P(), P()))
+        return jax.jit(fn)(x_sharded, q_repl)
+
+    keep_sh = shard_along(comms.mesh, comms.axis, keep)
+
+    def step(x_shard, keep_shard, q):
+        d_loc, i_loc = local_search(x_shard, q, keep_shard)
+        return merge(d_loc, i_loc, q.shape[0])
+
+    fn = comms.shard_map(step, in_specs=(P(comms.axis), P(comms.axis), P()),
+                         out_specs=(P(), P()))
+    return jax.jit(fn)(x_sharded, keep_sh, q_repl)

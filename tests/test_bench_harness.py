@@ -52,3 +52,37 @@ def test_harness_end_to_end(tmp_path):
     # probing all 8 lists is exhaustive → recall 1.0
     assert float(by_name["ivf"]["recall@5"]) > 0.99
     assert float(by_name["bf"]["qps"]) > 0
+
+
+def test_row_guard_hang_converts_to_labeled_row():
+    """A row body that hangs past the watchdog deadline (the observed
+    mid-build tunnel failure mode) must convert into a labeled error row plus
+    an exit-0 request — not rely on the driver's external kill."""
+    import threading
+
+    import bench
+
+    rows = []
+    exit_codes = []
+    ev = threading.Event()
+    bench._row_guard(rows, "hang_row", ev.wait, timeout_s=0.2,
+                     _exit=exit_codes.append)
+    ev.set()  # release the stuck daemon thread
+    assert exit_codes == [0]
+    assert rows and rows[0]["name"] == "hang_row"
+    assert "watchdog" in rows[0]["error"]
+
+
+def test_row_guard_success_and_error_paths():
+    import bench
+
+    rows = []
+    bench._row_guard(rows, "ok_row", lambda: None, timeout_s=5)
+    assert rows == []
+
+    def boom():
+        raise ValueError("boom")
+
+    bench._row_guard(rows, "err_row", boom, timeout_s=5)
+    assert rows[0]["name"] == "err_row"
+    assert rows[0]["error"].startswith("ValueError")

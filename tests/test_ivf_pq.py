@@ -210,10 +210,10 @@ class TestPq8Split:
                 np.testing.assert_allclose(
                     d[r, c], ((q[r] - recon) ** 2).sum(), rtol=5e-3, atol=1e-2)
 
-    def test_recall_beats_pq4_equal_bytes(self, data):
+    def test_recall_beats_pq4_same_pq_dim(self, data):
         """8 bits via 4+4 residual stages should rank at least as well as the
-        single-stage 4-bit codebook at HALF the code bytes (pq_dim equal) —
-        the added stage must buy quality."""
+        single-stage 4-bit codebook at the SAME pq_dim (so pq8 spends twice
+        the code bytes) — the added stage must buy quality."""
         x, q = data
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
         r8 = _recall(np.asarray(ivf_pq.search(
@@ -388,3 +388,64 @@ def test_int8_lut(rng):
     rec8 = np.mean([len(set(i8[r]) & set(gt[r])) / 10 for r in range(20)])
     rec32 = np.mean([len(set(i32[r]) & set(gt[r])) / 10 for r in range(20)])
     assert rec8 > rec32 - 0.1, (rec8, rec32)
+
+
+class TestScanImpls:
+    """The scan formulations (SearchParams.scan_impl) must agree: the one-hot
+    MXU contraction, the XLA compare+select chain, and the Pallas
+    dynamic-gather kernel (interpret mode on the CPU test platform) are three
+    spellings of the same Σ_s LUT[s, code_s] (BASELINE.md r04 scan study)."""
+
+    @pytest.mark.parametrize("bits", [4, 8])
+    def test_impls_agree(self, data, bits, monkeypatch):
+        monkeypatch.setenv("RAFT_TPU_PQ_SCAN_INTERPRET", "1")
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=32, pq_dim=8, pq_bits=bits, seed=0), x)
+        outs = {}
+        for impl in ("onehot", "select", "pallas"):
+            d, i = ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=8, scan_impl=impl), idx, q, 10)
+            outs[impl] = (np.asarray(d), np.asarray(i))
+        d0, i0 = outs["onehot"]
+        for impl in ("select", "pallas"):
+            d, i = outs[impl]
+            np.testing.assert_array_equal(i, i0, err_msg=impl)
+            np.testing.assert_allclose(d, d0, rtol=1e-5, atol=1e-4,
+                                       err_msg=impl)
+
+    def test_narrow_stage_guard(self, data):
+        from raft_tpu.core import RaftError
+
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=16, pq_dim=8, pq_bits=8, pq8_split=False, seed=0), x)
+        with pytest.raises(RaftError, match="16-wide"):
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=8, scan_impl="select"),
+                          idx, q, 10)
+
+    def test_int8_lut_needs_onehot(self, data):
+        from raft_tpu.core import RaftError
+
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0), x)
+        with pytest.raises(RaftError, match="one-hot"):
+            ivf_pq.search(ivf_pq.SearchParams(
+                n_probes=8, lut_dtype="int8", scan_impl="select"), idx, q, 10)
+
+    def test_split_consts_validated(self, data):
+        import dataclasses
+
+        from raft_tpu.core import RaftError
+        import jax.numpy as jnp
+
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=16, pq_dim=8, pq_bits=8, seed=0), x)
+        assert idx.pq_split
+        broken = dataclasses.replace(
+            idx, list_consts=jnp.zeros((idx.n_lists, 0), jnp.float32))
+        with pytest.raises(RaftError, match="list_consts"):
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=8), broken, q, 10)
+        with pytest.raises(RaftError, match="list_consts"):
+            ivf_pq.extend(broken, x[:8])
