@@ -35,7 +35,8 @@ def main():
     from raft_tpu.core.resources import default_resources
     from raft_tpu.distance.types import resolve_metric
     from raft_tpu.neighbors import cagra, ivf_pq
-    from raft_tpu.neighbors.cagra import _build_chunk_step, optimize
+    from raft_tpu.neighbors.cagra import (_build_chunk_step, knn_build_plan,
+                                          optimize)
 
     t_all = time.perf_counter()
     dataset, _ = drv._make_1m()
@@ -49,15 +50,11 @@ def main():
     params = cagra.IndexParams(build_chunk=args.chunk,
                                build_n_probes=args.probes)
     res = default_resources()
-    k = params.intermediate_graph_degree
-    gpu_top_k = min(int(k * params.refine_rate), n - 1)
-    n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
-    pq_bits = params.build_pq_bits or (
-        4 if ivf_pq._default_pq_dim(d, 8) >= 32 else 8)
+    k, gpu_top_k, n_lists, pq_bits = knn_build_plan(params, n, d)
 
     t0 = time.perf_counter()
     pq = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=min(n_lists, n // 4 if n >= 32 else n),
+        ivf_pq.IndexParams(n_lists=n_lists,
                            metric=params.metric, pq_bits=pq_bits,
                            seed=params.seed), dataset, res=res)
     jax.block_until_ready(pq.list_codes)

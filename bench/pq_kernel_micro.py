@@ -58,7 +58,6 @@ def main():
     from raft_tpu.ops.pq_scan import pq_lut_scan
 
     B, cap, S, K = 1024, 1336, 64, 16
-    rng = np.random.default_rng(0)
 
     def stack(seed):
         r = np.random.default_rng(seed)

@@ -150,23 +150,32 @@ class CagraIndex:
         return cls(*children, metric=metric)
 
 
+def knn_build_plan(params: IndexParams, n: int, d: int):
+    """Derived internal-build parameters (k, gpu_top_k, n_lists, pq_bits) —
+    one definition shared by build_knn_graph and bench/cagra_build_profile
+    so the profiler always measures the real pipeline."""
+    k = params.intermediate_graph_degree
+    gpu_top_k = min(int(k * params.refine_rate), n - 1)
+    n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
+    n_lists = min(n_lists, n // 4 if n >= 32 else n)
+    # threshold evaluated against the reference-equivalent ~d/2 heuristic
+    # (pq_bits=8 arg) so the bits-aware default change in _default_pq_dim
+    # does not shift this auto decision (pq4 from d >= 64, as documented)
+    pq_bits = params.build_pq_bits or (
+        4 if ivf_pq_mod._default_pq_dim(d, 8) >= 32 else 8)
+    return k, gpu_top_k, n_lists, pq_bits
+
+
 def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     """Stage 1 (reference: build_knn_graph, cagra_build.cuh:42): IVF-PQ over
     the dataset, search with queries = dataset, exact refine."""
     res = res or default_resources()
     x = jnp.asarray(dataset)
     n, d = x.shape
-    k = params.intermediate_graph_degree
-    gpu_top_k = min(int(k * params.refine_rate), n - 1)
-
-    n_lists = params.build_n_lists or max(int(n ** 0.5), 8)
-    # threshold evaluated against the reference-equivalent ~d/2 heuristic
-    # (pq_bits=8 arg) so the bits-aware default change in _default_pq_dim
-    # does not shift this auto decision (pq4 from d >= 64, as documented)
-    pq_bits = params.build_pq_bits or (4 if ivf_pq_mod._default_pq_dim(d, 8) >= 32 else 8)
+    k, gpu_top_k, n_lists, pq_bits = knn_build_plan(params, n, d)
     pq = ivf_pq_mod.build(
         ivf_pq_mod.IndexParams(
-            n_lists=min(n_lists, n // 4 if n >= 32 else n),
+            n_lists=n_lists,
             metric=params.metric,
             pq_bits=pq_bits,
             seed=params.seed,

@@ -410,9 +410,13 @@ class TestScanImpls:
         d0, i0 = outs["onehot"]
         for impl in ("select", "pallas"):
             d, i = outs[impl]
-            np.testing.assert_array_equal(i, i0, err_msg=impl)
-            np.testing.assert_allclose(d, d0, rtol=1e-5, atol=1e-4,
-                                       err_msg=impl)
+            # tie-robust: the formulations sum scores in different orders, so
+            # near-tied candidates may swap ranks — compare id SETS per row
+            # and the sorted distances, not positional ids
+            for r in range(i.shape[0]):
+                assert set(i[r].tolist()) == set(i0[r].tolist()), (impl, r)
+            np.testing.assert_allclose(np.sort(d, 1), np.sort(d0, 1),
+                                       rtol=1e-5, atol=1e-4, err_msg=impl)
 
     def test_narrow_stage_guard(self, data):
         from raft_tpu.core import RaftError
