@@ -457,6 +457,9 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
     def body(state):
         ids, dists, visited, it, _ = state
         # pick the best `width` unvisited entries within the itopk window
+        # (the all-converged early-exit check rides along: an r04 interleaved
+        # A/B measured it free at m=10k — 28.5-31.0k QPS with vs 28.4-29.6k
+        # without — so it stays unconditional)
         cand_d = jnp.where(visited[:, :itopk], jnp.inf, dists[:, :itopk])
         pick = jnp.argsort(cand_d, axis=1, stable=True)[:, :width]  # (m, w)
         pick_ids = jnp.take_along_axis(ids, pick, axis=1)  # (m, w)
