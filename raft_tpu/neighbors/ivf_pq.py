@@ -123,6 +123,25 @@ class SearchParams:
     #              (XLA materializes each of the 16 passes); reference impl.
     #   "auto"   — onehot (fastest measured everywhere).
     scan_impl: str = "auto"
+    # scan ORDER (orthogonal to scan_impl):
+    #   "tiled"   — query-major (r01-r03): (query_tile, probe_chunk) walks,
+    #               one-hot operand rebuilt per (query, probe) pair.
+    #   "grouped" — probe-major (r04): the batch's (query, probe) pairs sort
+    #               by list id; each group of `group_size` pairs sharing a
+    #               list scores ONE shared one-hot against all its LUTs in
+    #               a real-N MXU matmul (G-way operand amortization). Needs
+    #               k <= capacity. MEASURED NEUTRAL at 1M (40.7k vs the
+    #               tiled path's 41.2k QPS, ~61 pairs/list): the 4x operand
+    #               -traffic cut bought nothing, i.e. the tiled contraction
+    #               was never operand-bound — XLA fuses the one-hot producer
+    #               into the dot (BASELINE.md "Round-4 grouped scan"). Kept
+    #               as a tested option; the balance may flip at higher
+    #               pairs-per-list ratios or future XLA versions.
+    #   "auto"    — tiled (measured at least as fast everywhere tried).
+    scan_order: str = "auto"
+    # pairs per group for the grouped order (padding waste rises, and
+    # amortization improves, with larger G)
+    group_size: int = 16
 
 
 @jax.tree_util.register_pytree_node_class
@@ -902,6 +921,181 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
     return dists, idx
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "metric", "codebook_kind", "lut_dtype",
+                     "group_size", "group_chunk"),
+)
+def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
+                       metric: DistanceType, codebook_kind: str,
+                       lut_dtype: str, keep_mask=None, group_size: int = 16,
+                       group_chunk: int = 32):
+    """Probe-major grouped scan (r04, BASELINE.md "Round-4 PQ scan study"):
+    the per-(query, probe) one-hot contraction is an N=1 batched matvec that
+    rebuilds a (cap, pq_dim*K) one-hot operand per pair. Here the (query,
+    probe) pairs of the WHOLE batch are sorted by list id and split into
+    groups of ``group_size`` pairs sharing one list, so each group scores
+    ONE one-hot operand against all its queries' LUTs in a single real-N
+    MXU matmul — operand traffic amortizes G ways. The reference reaches
+    the same amortization through smem residency (its kernel pins the LUT
+    per (query, probe) CTA, ivf_pq_compute_similarity-inl.cuh); a TPU has
+    no smem, so sharing swaps sides: codes are shared, LUTs batch.
+
+    Static shapes: padded slots P = m*p + (G-1)*n_lists upper-bounds the
+    per-list round-up; empty groups scan list 0 masked. All reordering is
+    sort/gather-based (no scatters — XLA serializes those on TPU).
+    """
+    m, d = queries.shape
+    qf = queries.astype(jnp.float32)
+    inner = metric == DistanceType.InnerProduct
+    pq_dim, pq_len = index.pq_dim, index.pq_len
+    n_codes = index.codebooks.shape[-2]
+    L = index.list_codes.shape[0]
+    cap = index.capacity
+    G, Gc = group_size, group_chunk
+
+    # ---- stage 1: coarse clusters + rotated queries (as the tiled path) ----
+    cscore = qf @ index.centers.T
+    if not inner:
+        cn = jnp.sum(index.centers * index.centers, axis=1)
+        cscore = cn[None, :] - 2.0 * cscore
+    _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+    qrot = qf @ index.rotation.T
+
+    # ---- pair grouping (sorted-space, scatter-free) ----
+    mp = m * n_probes
+    pairs = probes.reshape(-1).astype(jnp.int32)           # (mp,) list ids
+    order = jnp.argsort(pairs, stable=True)                # sorted pair -> orig pair
+    sorted_list = jnp.take(pairs, order)
+    counts = jnp.bincount(pairs, length=L)
+    padded = -(-counts // G) * G
+    pstart = jnp.cumsum(padded) - padded                   # padded starts
+    starts = jnp.cumsum(counts) - counts                   # sorted-run starts
+    pos = jnp.arange(mp, dtype=jnp.int32) - jnp.take(starts, sorted_list)
+    slot_sorted = (jnp.take(pstart, sorted_list) + pos).astype(jnp.int32)
+
+    # static bound on padded slots: at most min(L, mp) lists have pairs, each
+    # contributing < G padding (a bound of (G-1)*L would scan mostly dead
+    # groups for small batches on many-list indexes)
+    P = mp + (G - 1) * min(L, mp)
+    n_groups = -(-P // G)
+    n_chunks = -(-n_groups // Gc)
+    # slot -> sorted-pair occupancy, via binary search on the monotonic
+    # slot_sorted (slots without a pair are padding)
+    all_slots = jnp.arange(n_chunks * Gc * G, dtype=jnp.int32)
+    j_of_slot = jnp.searchsorted(slot_sorted, all_slots).astype(jnp.int32)
+    jc = jnp.minimum(j_of_slot, mp - 1)
+    slot_live = (j_of_slot < mp) & (jnp.take(slot_sorted, jc) == all_slots)
+    # slot -> list id (group-constant; list of the sorted run covering it)
+    lend = pstart + padded
+    l_of_slot = (jnp.searchsorted(lend, all_slots, side="right")
+                 .astype(jnp.int32))
+    l_of_slot = jnp.minimum(l_of_slot, L - 1)
+    # slot -> query row (0 for padding, masked later)
+    orig_pair = jnp.take(order, jc)
+    q_of_slot = jnp.where(slot_live, orig_pair // n_probes, 0).astype(jnp.int32)
+
+    cb = index.codebooks.astype(jnp.float32)
+    cb_n2 = jnp.sum(cb * cb, axis=-1)
+    ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+    q_slot = q_of_slot.reshape(n_chunks, Gc, G)
+    l_slot = l_of_slot.reshape(n_chunks, Gc, G)
+    live_slot = slot_live.reshape(n_chunks, Gc, G)
+    l_group = l_slot[:, :, 0]                              # (n_chunks, Gc)
+
+    def per_chunk(args):
+        qs, ls, lg, live = args  # (Gc, G), (Gc, G), (Gc,), (Gc, G)
+        # ---- LUTs for this chunk's slots ----
+        qr = jnp.take(qrot, qs.reshape(-1), axis=0)        # (Gc*G, d_rot)
+        crot = jnp.take(index.centers_rot, ls.reshape(-1), axis=0)
+        if inner:
+            rs = qr.reshape(-1, pq_dim, pq_len)
+            if codebook_kind == "per_subspace":
+                lut = jnp.einsum("nsl,skl->nsk", rs, cb,
+                                 precision=lax.Precision.HIGHEST)
+            else:
+                cbl = jnp.take(cb, ls.reshape(-1), axis=0)
+                lut = jnp.einsum("nsl,nkl->nsk", rs, cbl,
+                                 precision=lax.Precision.HIGHEST)
+            bias = jnp.einsum("nd,nd->n", qr, crot,
+                              precision=lax.Precision.HIGHEST)
+        else:
+            r = (qr - crot).reshape(-1, pq_dim, pq_len)
+            if codebook_kind == "per_subspace":
+                dots = jnp.einsum("nsl,skl->nsk", r, cb,
+                                  precision=lax.Precision.HIGHEST)
+                lut = cb_n2[None] - 2.0 * dots
+            else:
+                cbl = jnp.take(cb, ls.reshape(-1), axis=0)
+                dots = jnp.einsum("nsl,nkl->nsk", r, cbl,
+                                  precision=lax.Precision.HIGHEST)
+                lut = jnp.take(cb_n2, ls.reshape(-1), axis=0)[:, None] - 2.0 * dots
+            bias = jnp.sum(r * r, axis=(1, 2))
+        lutf = lut.reshape(Gc, G, pq_dim * n_codes)
+
+        # ---- shared one-hot per group's list ----
+        codes = jnp.take(index.list_codes, lg, axis=0)     # (Gc, cap, pq_dim)
+        ids = jnp.take(index.list_ids, lg, axis=0)         # (Gc, cap)
+        if index.pq_split:
+            ar16 = jnp.arange(16, dtype=codes.dtype)
+            oh = jnp.concatenate(
+                [(codes >> 4)[..., None] == ar16,
+                 (codes & 0xF)[..., None] == ar16], axis=-1)
+        else:
+            oh = codes[..., None] == jnp.arange(n_codes, dtype=codes.dtype)
+        ohf = oh.reshape(Gc, cap, pq_dim * n_codes)
+
+        # ---- ONE real-N matmul per group: (cap, D) x (D, G) ----
+        if lut_dtype == "int8":
+            amax = jnp.max(jnp.abs(lutf), axis=2, keepdims=True)
+            scale = jnp.maximum(amax, 1e-30) / 127.0
+            lut_q = jnp.clip(jnp.round(lutf / scale), -127, 127).astype(jnp.int8)
+            acc = lax.dot_general(
+                ohf.astype(jnp.int8), lut_q, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32)          # (Gc, cap, G)
+            scores = acc.astype(jnp.float32) * jnp.swapaxes(scale, 1, 2)
+        else:
+            scores = lax.dot_general(
+                ohf.astype(ct), jnp.swapaxes(lutf.astype(ct), 1, 2),
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)        # (Gc, cap, G)
+        scores = scores + bias.reshape(Gc, 1, G)
+        if index.pq_split and not inner:
+            scores = scores + jnp.take(index.list_consts, lg, axis=0)[:, :, None]
+        bad = jnp.inf if not inner else -jnp.inf
+        scores = jnp.where(ids[:, :, None] >= 0, scores, bad)
+        sc_t = jnp.swapaxes(scores, 1, 2).reshape(Gc * G, cap)
+        ids_t = jnp.broadcast_to(ids[:, None, :], (Gc, G, cap)
+                                 ).reshape(Gc * G, cap)
+        if keep_mask is not None:
+            from .sample_filter import apply_id_filter
+
+            sc_t = apply_id_filter(sc_t, ids_t, keep_mask, not inner)
+        sv, si = _select_k(sc_t, ids_t, k, not inner)      # (Gc*G, k)
+        sv = jnp.where(live.reshape(-1, 1), sv, bad)
+        si = jnp.where(live.reshape(-1, 1), si, -1)
+        return sv, si
+
+    slot_v, slot_i = lax.map(per_chunk, (q_slot, l_slot, l_group, live_slot))
+    slot_v = slot_v.reshape(-1, k)                         # (n_chunks*Gc*G, k)
+    slot_i = slot_i.reshape(-1, k)
+
+    # ---- un-sort: slot results -> per-pair -> per-query merge ----
+    pv = jnp.take(slot_v, slot_sorted, axis=0)             # sorted-pair order
+    pi = jnp.take(slot_i, slot_sorted, axis=0)
+    inv = jnp.argsort(order)                               # orig-pair order
+    pv = jnp.take(pv, inv, axis=0).reshape(m, n_probes * k)
+    pi = jnp.take(pi, inv, axis=0).reshape(m, n_probes * k)
+    dists, idx = _select_k(pv, pi, k, not inner)
+    if not inner and metric in (DistanceType.L2SqrtExpanded,
+                                DistanceType.L2SqrtUnexpanded):
+        dists = jnp.where(jnp.isfinite(dists),
+                          jnp.sqrt(jnp.maximum(dists, 0.0)), dists)
+    empty = ~jnp.isfinite(dists)
+    idx = jnp.where(empty, -1, idx)
+    return dists, idx
+
+
 @auto_convert_output
 def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
            sample_filter=None, res: Resources | None = None):
@@ -947,6 +1141,28 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
         from .sample_filter import validate_filter_covers
 
         validate_filter_covers(index, keep_mask)
+    expects(params.scan_order in ("auto", "tiled", "grouped"),
+            "scan_order must be 'auto', 'tiled' or 'grouped', got %r",
+            params.scan_order)
+    scan_order = params.scan_order
+    if scan_order == "auto":
+        # tiled: the grouped order measured neutral at 1M (its 4x operand
+        # -traffic cut bought nothing — the tiled one-hot contraction is not
+        # operand-bound; BASELINE.md "Round-4 grouped scan")
+        scan_order = "tiled"
+    if scan_order == "grouped":
+        expects(k <= index.capacity,
+                "scan_order='grouped' selects per (pair, list): k=%d must be "
+                "<= capacity=%d", k, index.capacity)
+        expects(scan_impl == "onehot",
+                "scan_order='grouped' implements the one-hot contraction; "
+                "set scan_impl='onehot' (or 'auto')")
+        expects(1 <= params.group_size <= 1024,
+                "group_size must be in [1, 1024], got %d", params.group_size)
+        return _pq_search_grouped(
+            index, queries, n_probes, int(k), index.metric,
+            index.codebook_kind, params.lut_dtype, keep_mask,
+            group_size=int(params.group_size))
     return _pq_search(
         index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
         index.codebook_kind, params.lut_dtype,

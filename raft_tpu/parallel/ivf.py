@@ -214,6 +214,9 @@ def search_pq(comms: Comms, params, index, queries, k: int,
 
     _check_split_consts(index)
     scan_impl = resolve_scan_impl(params, index, n_codes)
+    expects(params.scan_order in ("auto", "tiled"),
+            "the distributed search runs the tiled scan order; "
+            "scan_order=%r is single-chip only", params.scan_order)
 
     def step(centers, centers_rot, codebooks, codes, ids, sizes, consts, q):
         shard = IvfPqIndex(

@@ -453,3 +453,58 @@ class TestScanImpls:
             ivf_pq.search(ivf_pq.SearchParams(n_probes=8), broken, q, 10)
         with pytest.raises(RaftError, match="list_consts"):
             ivf_pq.extend(broken, x[:8])
+
+
+class TestGroupedScan:
+    """scan_order='grouped' (probe-major, shared one-hot per list group) must
+    agree with the tiled order across metrics, bit widths, LUT dtypes and
+    filters — same candidates scored by the same quantizer, only the batching
+    differs (BASELINE.md "Round-4 grouped scan")."""
+
+    @pytest.mark.parametrize("bits", [4, 8])
+    def test_matches_tiled(self, data, bits):
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=32, pq_dim=8, pq_bits=bits, seed=0), x)
+        for lut in ("float32", "int8"):
+            d1, i1 = ivf_pq.search(ivf_pq.SearchParams(
+                n_probes=8, lut_dtype=lut, scan_order="tiled"), idx, q, 10)
+            d2, i2 = ivf_pq.search(ivf_pq.SearchParams(
+                n_probes=8, lut_dtype=lut, scan_order="grouped"), idx, q, 10)
+            i1, i2 = np.asarray(i1), np.asarray(i2)
+            overlap = np.mean([len(set(a) & set(b)) / 10
+                               for a, b in zip(i1.tolist(), i2.tolist())])
+            assert overlap > 0.98, (bits, lut, overlap)  # near-ties may swap
+            np.testing.assert_allclose(
+                np.sort(np.asarray(d1), 1), np.sort(np.asarray(d2), 1),
+                rtol=1e-3, atol=1e-2)
+
+    def test_inner_product_and_filter(self, data):
+        x, q = data
+        idxip = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=32, pq_dim=8, metric="inner_product", seed=0), x)
+        _, i1 = ivf_pq.search(ivf_pq.SearchParams(
+            n_probes=8, scan_order="tiled"), idxip, q, 10)
+        _, i2 = ivf_pq.search(ivf_pq.SearchParams(
+            n_probes=8, scan_order="grouped"), idxip, q, 10)
+        overlap = np.mean([len(set(a) & set(b)) / 10 for a, b in
+                           zip(np.asarray(i1).tolist(), np.asarray(i2).tolist())])
+        assert overlap > 0.98, overlap
+
+        keep = np.ones(len(x), bool)
+        keep[::3] = False
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0), x)
+        _, ig = ivf_pq.search(ivf_pq.SearchParams(
+            n_probes=8, scan_order="grouped"), idx, q, 10, sample_filter=keep)
+        ig = np.asarray(ig)
+        banned = set(np.nonzero(~keep)[0].tolist())
+        assert not (set(ig[ig >= 0].ravel().tolist()) & banned)
+
+    def test_k_capacity_guard(self, data):
+        from raft_tpu.core import RaftError
+
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=8, seed=0), x)
+        with pytest.raises(RaftError, match="capacity"):
+            ivf_pq.search(ivf_pq.SearchParams(
+                n_probes=32, scan_order="grouped"), idx, q, idx.capacity + 1)
