@@ -62,12 +62,46 @@ def load_dataset(spec: dict):
         rng = np.random.default_rng(syn.get("seed", 0))
         n_clusters = syn.get("clusters", 0)
         if n_clusters:
-            # clustered data (gaussian blobs): realistic IVF/graph recall
-            # behavior, unlike uniform noise
             dim = syn["dim"]
             centers = rng.random((n_clusters, dim), np.float32) * 10
             std = syn.get("cluster_std", 0.5)
+            idim = syn.get("intrinsic_dim", 0)
+            if idim:
+                # SIFT-class: low intrinsic dimension + multi-scale local
+                # density (sub-clumps within each cluster) — the same
+                # dataset CLASS as bench.py:_make_lid_1m (the driver
+                # regression row; BASELINE.md "Round-4 SIFT-class dataset
+                # study"), not the same instance: bench.py draws on-device
+                # with jax.random (a host generator would cost a 512 MB
+                # tunnel upload), this harness draws host-side; parameters
+                # live in the conf so the two stay tuned together
+                n_clumps = syn.get("clumps", 16)
+                fine_std = syn.get("fine_std", 0.15)
+                bases = rng.normal(size=(n_clusters, idim, dim)).astype(np.float32)
+                bases /= np.linalg.norm(bases, axis=-1, keepdims=True)
+                offsets = (std * rng.normal(
+                    size=(n_clusters, n_clumps, idim))).astype(np.float32)
 
+                def draw(count):
+                    # chunked: bases[labels] is a (count, idim, dim) f32
+                    # temporary (~8.2 GB at 1M x 16 x 128 — the same hazard
+                    # bench.py bounds with 50k-row blocks)
+                    parts = []
+                    for s in range(0, count, 50_000):
+                        c = min(50_000, count - s)
+                        labels = rng.integers(0, n_clusters, c)
+                        clump = rng.integers(0, n_clumps, c)
+                        z = (offsets[labels, clump]
+                             + fine_std * rng.normal(size=(c, idim))
+                             ).astype(np.float32)
+                        parts.append((centers[labels] + np.einsum(
+                            "ni,nid->nd", z, bases[labels])).astype(np.float32))
+                    return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+                return draw(syn["n"]), draw(syn["n_queries"]), metric
+
+            # clustered data (gaussian blobs): realistic IVF/graph recall
+            # behavior, unlike uniform noise
             def draw(count):
                 labels = rng.integers(0, n_clusters, count)
                 return (centers[labels] + rng.normal(0, std, (count, dim))).astype(np.float32)
