@@ -109,7 +109,12 @@ class SearchParams:
     # edges. Pool size sets the entry-coverage recall ceiling at scale:
     # measured at 1M x 128 / 2000 clusters (itopk=32), pool 4096 → 0.846
     # recall, 16384 → 0.973 at identical QPS — the GEMM is not the hop
-    # loop's bottleneck. 0 → plain random entries (reference behavior).
+    # loop's bottleneck. SIZE THE POOL TO THE DATA'S LOCAL MODES: on
+    # multi-scale (near-duplicate-clump) data with ~32k clumps, 16384 →
+    # 0.880 but 65536 → 0.979 (-13% QPS) and 131072 → 0.995 (-24%) at
+    # itopk=32 (r04, BASELINE.md "Round-4 SIFT-class 1M harness sweep") —
+    # the beam cannot hop into a clump no seed landed near. 0 → plain
+    # random entries (reference behavior).
     seed_pool: int = 16384
     # RNG seed (int / RngState / raw key) for the seed-pool draw (ref
     # search_params :118 rand_xor_mask). Determinism contract: the same
