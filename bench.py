@@ -18,6 +18,9 @@ comparable), served by the fused Pallas distance+top-k kernel
 archives per round:
 
   exact_fused_knn_100k           f32 (exact) flagship — the primary value
+  exact_xla_control              plain XLA GEMM+top_k, SAME process/queries —
+                                 the fused/control ratio is the session-
+                                 independent round-over-round signal
   exact_fused_knn_100k_bf16      same kernel, single-pass bf16 MXU mode
   exact_fused_knn_100k_f32x3     compensated bf16x3 mode (f32-class accuracy)
   ivf_pq_1m_lid_pq4x64_r4        IVF-PQ on the SIFT-class low-intrinsic-dim
@@ -141,6 +144,15 @@ def _flagship_exact(rows):
                 dataset, q, k, DistanceType.L2Expanded, mode, None), qs)
         return searches
 
+    # ONE definition of the plain XLA GEMM+top_k pipeline, shared by the
+    # fused-failure fallback and the in-process control row — the two must
+    # measure the same pipeline by construction
+    def searches_xla(qs):
+        from raft_tpu.neighbors.brute_force import _bf_knn
+
+        return lax.map(lambda q: _bf_knn(
+            dataset, q, k, DistanceType.L2Expanded, 2.0, 1000, 1000), qs)
+
     try:
         qps, out_f32 = _measure_qps(mode_searches("float32"), qsets,
                                     n_batches * m)
@@ -154,15 +166,9 @@ def _flagship_exact(rows):
         # primary number still prints, clearly labeled as the fallback (the
         # top-level vs_baseline is nulled so rounds are not compared
         # apples-to-oranges)
-        from raft_tpu.neighbors.brute_force import _bf_knn
-
         _STATE["fused_ok"] = False
         rows.append({"name": "exact_fused_knn_100k", "error": str(e)[:200]})
         try:
-            def searches_xla(qs):
-                return lax.map(lambda q: _bf_knn(
-                    dataset, q, k, DistanceType.L2Expanded, 2.0, 1000, 1000), qs)
-
             qps, _ = _measure_qps(searches_xla, qsets, n_batches * m)
             _STATE["primary"] = qps
             rows.append({"name": "exact_xla_knn_100k_fallback",
@@ -171,6 +177,20 @@ def _flagship_exact(rows):
             rows.append({"name": "exact_xla_knn_100k_fallback",
                          "error": str(e2)[:200]})
         return
+
+    # in-process control (VERDICT r4 #7): the plain XLA GEMM+top_k pipeline
+    # measured in the SAME process on the SAME query sets. Tunnel sessions
+    # swing tens of percent between runs (BASELINE.md protocol), so the
+    # round-over-round signal is the fused/control RATIO within one process,
+    # not the absolute vs_baseline quotient across sessions.
+    try:
+        qps_c, _ = _measure_qps(searches_xla, qsets, n_batches * m)
+        rows.append({"name": "exact_xla_control", "qps": round(qps_c, 1),
+                     "recall": 1.0, "build_s": 0.0,
+                     "fused_over_control": round(_STATE["primary"] / qps_c, 3)})
+    except Exception as e:  # pragma: no cover - bench resilience
+        rows.append({"name": "exact_xla_control", "error": str(e)[:200]})
+    _emit()
 
     # bf16 (one MXU pass instead of six; ~0.98 worst-case set recall on
     # uniform data) and f32x3 (three passes, f32-class accuracy) modes,

@@ -56,6 +56,10 @@ _SUBMODULES = {
 def __getattr__(name):
     if name in _SUBMODULES:
         return importlib.import_module(f".{name}", __name__)
+    if name == "warmup":  # AOT cache warmup entry point (docs/warm_builds.md)
+        fn = importlib.import_module("._warmup", __name__).warmup
+        globals()["warmup"] = fn
+        return fn
     raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,103 @@
+"""Ahead-of-time warmup: pre-populate the persistent compilation cache.
+
+The reference ships ahead-of-time compiled kernels in ``libraft.so`` (the
+explicit-instantiation machinery, SURVEY.md R1/R2; compile-mode matrix
+``cpp/test/CMakeLists.txt:183-190``), so a user's first 1M build never pays
+device-code compilation. The TPU analogue is the persistent XLA compilation
+cache (``config.enable_compilation_cache``) — but the cache only helps a
+*second* process; a fresh host still pays minutes of cold jit on the flagship
+path (1M ivf_pq: 103.6 s cold vs 7.3 s warm, BASELINE.md r04 harness).
+
+``warmup`` closes that first-touch gap: run it once per host — at deploy
+time, in a provisioning step, off the serving path — with the shapes you will
+build and search at, and every subsequent process (including the first
+user-facing one) compiles from the cache. It executes the real build+search
+pipeline on device-generated random data of the target shapes, because the
+cache is keyed by HLO: only the genuinely identical programs (same shapes,
+same static config) hit.
+
+    import raft_tpu
+    raft_tpu.warmup("ivf_pq", n=1_000_000, d=128)        # once, at deploy
+    # ... later, any process on this host ...
+    idx = ivf_pq.build(params, dataset)                   # warm: seconds
+
+Random data is generated ON DEVICE (a 512 MB host->device transfer would
+dominate), and the warmup returns its own build/search wall times so a
+provisioning script can log them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+__all__ = ["warmup"]
+
+_KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+
+def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
+           index_params: Any | None = None, search_params: Any | None = None,
+           cache_dir: str | None = None, seed: int = 0) -> dict:
+    """Compile-warm one index kind at (n, d) build / (queries, d) search.
+
+    Enables the persistent compilation cache (``cache_dir`` or the default
+    ``~/.cache/raft_tpu/jit``), builds the index on uniform random data of
+    the target shape, runs one search of the target batch shape, and returns
+    ``{"build_s": ..., "search_s": ..., "cache_dir": ...}``. Pass the same
+    ``index_params``/``search_params`` you will use in production — the
+    cache keys on static config (n_lists, pq_dim, itopk, ...), so a warmup
+    with different params warms different programs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .config import enable_compilation_cache
+    from .core.errors import expects
+
+    expects(kind in _KINDS, "unknown index kind %r (one of %s)", kind,
+            ", ".join(_KINDS))
+    cache = enable_compilation_cache(cache_dir)
+    kd, kq = jax.random.split(jax.random.key(seed))
+    x = jax.random.uniform(kd, (n, d), jnp.float32)
+    q = jax.random.uniform(kq, (queries, d), jnp.float32)
+    jax.block_until_ready((x, q))
+
+    t0 = time.perf_counter()
+    if kind == "brute_force":
+        from .neighbors import brute_force
+
+        idx = brute_force.BruteForce().build(x)
+        searcher = lambda: idx.search(q, k)
+    elif kind == "ivf_flat":
+        from .neighbors import ivf_flat
+
+        idx = ivf_flat.build(
+            index_params or ivf_flat.IndexParams(n_lists=1024, seed=seed), x)
+        jax.block_until_ready(idx.list_data)
+        searcher = lambda: ivf_flat.search(
+            search_params or ivf_flat.SearchParams(n_probes=8), idx, q, k)
+    elif kind == "ivf_pq":
+        from .neighbors import ivf_pq
+
+        idx = ivf_pq.build(
+            index_params or ivf_pq.IndexParams(
+                n_lists=1024, pq_bits=4, pq_dim=min(64, d), seed=seed), x)
+        jax.block_until_ready(idx.list_codes)
+        searcher = lambda: ivf_pq.search(
+            search_params or ivf_pq.SearchParams(
+                n_probes=8, lut_dtype="bfloat16"), idx, q, max(k, 40))
+    else:  # cagra
+        from .neighbors import cagra
+
+        idx = cagra.build(index_params or cagra.IndexParams(seed=seed), x)
+        jax.block_until_ready(idx.graph)
+        searcher = lambda: cagra.search(
+            search_params or cagra.SearchParams(itopk_size=32), idx, q, k)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.tree_util.tree_leaves(searcher())[0])
+    search_s = time.perf_counter() - t0
+    return {"build_s": round(build_s, 2), "search_s": round(search_s, 2),
+            "cache_dir": cache}

@@ -37,14 +37,17 @@ __all__ = [
 # with a clear message instead of being misread.
 #   raft_tpu/2: version header added; ivf_flat/ivf_pq carry split_factor.
 #   raft_tpu/3: ivf_pq carries pq_split + list_consts (nibble-split pq8).
-SERIALIZATION_VERSION = "raft_tpu/3"
+#   raft_tpu/4: cagra carries seed_pool_hint (measured search autotune).
+SERIALIZATION_VERSION = "raft_tpu/4"
 
-# Older versions each tag can still READ (only ivf_pq's layout changed in
-# raft_tpu/3, so ivf_flat/cagra files saved under raft_tpu/2 stay loadable —
-# bumping the global version must not force rebuilds of unchanged formats).
+# Older versions each tag can still READ (only cagra's layout changed in
+# raft_tpu/4, only ivf_pq's in raft_tpu/3 — bumping the global version must
+# not force rebuilds of unchanged formats; loaders branch on the returned
+# version where a field was added).
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
-    "ivf_flat": frozenset({"raft_tpu/2"}),
-    "cagra": frozenset({"raft_tpu/2"}),
+    "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3"}),
+    "ivf_pq": frozenset({"raft_tpu/3"}),
+    "cagra": frozenset({"raft_tpu/2", "raft_tpu/3"}),
 }
 
 
@@ -54,8 +57,9 @@ def serialize_header(fp: BinaryIO, tag: str) -> None:
     serialize_scalar(fp, SERIALIZATION_VERSION)
 
 
-def check_header(fp: BinaryIO, tag: str) -> None:
-    """Read and validate the header, failing with actionable messages."""
+def check_header(fp: BinaryIO, tag: str) -> str:
+    """Read and validate the header, failing with actionable messages.
+    Returns the file's version string so loaders can branch on old layouts."""
     from .errors import expects
 
     got = deserialize_scalar(fp)
@@ -70,6 +74,7 @@ def check_header(fp: BinaryIO, tag: str) -> None:
         "the index",
         tag, ver, SERIALIZATION_VERSION,
     )
+    return ver
 
 
 def serialize_mdspan(fp: BinaryIO, arr) -> None:

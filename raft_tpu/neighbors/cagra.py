@@ -109,13 +109,20 @@ class SearchParams:
     # edges. Pool size sets the entry-coverage recall ceiling at scale:
     # measured at 1M x 128 / 2000 clusters (itopk=32), pool 4096 → 0.846
     # recall, 16384 → 0.973 at identical QPS — the GEMM is not the hop
-    # loop's bottleneck. SIZE THE POOL TO THE DATA'S LOCAL MODES: on
+    # loop's bottleneck. THE POOL MUST SCALE WITH THE DATA'S LOCAL MODES: on
     # multi-scale (near-duplicate-clump) data with ~32k clumps, 16384 →
     # 0.880 but 65536 → 0.979 (-13% QPS) and 131072 → 0.995 (-24%) at
     # itopk=32 (r04, BASELINE.md "Round-4 SIFT-class 1M harness sweep") —
-    # the beam cannot hop into a clump no seed landed near. 0 → plain
-    # random entries (reference behavior).
-    seed_pool: int = 16384
+    # the beam cannot hop into a clump no seed landed near.
+    #   -1 (default) → AUTO: use the index's measured seed_pool_hint (the
+    #     build estimates the local-mode count from the knn graph's
+    #     neighbor-distance jump profile — the search-side twin of the r04
+    #     build_n_probes autotune; reference analogue: adjust_search_params,
+    #     detail/cagra/search_plan.cuh:119), falling back to 16384 when the
+    #     build saw no clump structure.
+    #   0 → plain random entries (reference behavior).
+    #   >0 → explicit pool size, honored as-is.
+    seed_pool: int = -1
     # RNG seed (int / RngState / raw key) for the seed-pool draw (ref
     # search_params :118 rand_xor_mask). Determinism contract: the same
     # (seed, index, queries, params) always searches the same sampled pool,
@@ -134,6 +141,18 @@ class CagraIndex:
     dataset: jax.Array  # (n, d)
     graph: jax.Array  # (n, graph_degree) int32
     metric: DistanceType = DistanceType.L2Expanded
+    # measured at build time from the knn graph's neighbor-distance jump
+    # profile: the seed-pool size that covers the data's local modes
+    # (0 = no clump structure detected; SearchParams.seed_pool=-1 consumes
+    # this). The reference stores no search hints on the index — its
+    # adjust_search_params (search_plan.cuh:119) rescales at search time
+    # from itopk alone, which cannot see data clumpiness.
+    # NOT part of the pytree (neither child nor aux): search() resolves it
+    # on the host BEFORE the jit boundary, and putting it in aux would make
+    # indexes differing only in hint recompile _cagra_search (minutes at
+    # 1M). Pytree round trips (device_put, tree_map) drop it back to 0 —
+    # the default pool, never an error; save/load preserves it.
+    seed_pool_hint: int = 0
 
     @property
     def size(self) -> int:
@@ -220,13 +239,22 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
         wide = chunk_step(0, 32)
         parts.append(wide)
         if n > chunk:  # autotune only pays when more chunks follow
-            # trials run on a 2048-row sub-chunk (the decision sample), not
-            # the full chunk — the trial search itself is the cost being
-            # tuned away
+            # the decision sample is up to 2048 rows drawn UNIFORMLY across
+            # [0, n) — row order often correlates with structure (data
+            # appended cluster-by-cluster), so a head slice would judge p=8
+            # on one unrepresentative region (r04 advisor finding). The
+            # extra wide search of the sample is cheap relative to the
+            # build. Clamped by build_chunk: the user's chunk bound exists
+            # to keep any single dispatch under VMEM/watchdog limits, and
+            # trial dispatches must honor it too.
             t_rows = min(2048, chunk, n)
-            xt = x[:t_rows]
-            rt = jnp.arange(t_rows, dtype=jnp.int32)
-            wide_h = np.asarray(wide)[:t_rows]
+            rng = np.random.default_rng(params.seed)
+            sample = np.sort(rng.choice(n, size=t_rows, replace=False))
+            rt = jnp.asarray(sample, dtype=jnp.int32)
+            xt = x[rt]
+            wide_h = np.asarray(_build_chunk_step(
+                x, pq, xt, rt, 32, int(gpu_top_k), int(k), mt,
+                int(res.workspace_bytes)))
             for p_try in (8, 16):
                 trial = np.asarray(_build_chunk_step(
                     x, pq, xt, rt, p_try, int(gpu_top_k), int(k), mt,
@@ -367,6 +395,74 @@ def optimize(knn_graph, out_degree: int, res: Resources | None = None):
     return _reverse_merge(pruned, out_degree)
 
 
+@jax.jit
+def _neighbor_dist_profile(x, knn_graph, sample_ids):
+    """Sorted squared L2 from sampled rows to their knn-graph neighbors —
+    the raw material for the seed-pool autotune (one small gather + dot)."""
+    xs = x[sample_ids].astype(jnp.float32)  # (t, d)
+    vecs = x[knn_graph[sample_ids]].astype(jnp.float32)  # (t, kk, d)
+    d2 = jnp.sum((vecs - xs[:, None, :]) ** 2, axis=-1)
+    return jnp.sort(d2, axis=1)
+
+
+def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
+    """Measured seed-pool policy (the search-side twin of the r04
+    build_n_probes autotune; reference analogue: adjust_search_params,
+    detail/cagra/search_plan.cuh:119 — which rescales from itopk alone and
+    cannot see data structure).
+
+    Mechanism: the search seeds the beam from a uniformly-sampled pool, and
+    the pruned graph rarely crosses between near-duplicate clumps — so
+    recall at scale is capped by how many local modes the pool covers
+    (BASELINE.md r04 seed_pool sweep: 16384 → 0.880 on ~32k-clump data,
+    65536 → 0.979). The clump scale is read off the knn graph the build just
+    produced: on multi-scale data each node's sorted neighbor distances jump
+    sharply (≥4x in squared distance) at the clump boundary; the median jump
+    position is the clump size s, n/s the mode count M, and pool = ~2M
+    samples seed ≥85% of modes (1 - e^-2), which the beam's cross-clump hops
+    finish off. Isotropic/single-scale data shows no ≥4x jump and keeps the
+    default pool (a bigger pool there is a pure QPS loss — r02: -18% QPS for
+    +0.0001 recall).
+    """
+    import numpy as np
+
+    x = jnp.asarray(dataset)
+    g = jnp.asarray(knn_graph)
+    n = x.shape[0]
+    if n < 4096 or g.shape[1] < 8:
+        return 0  # below any scale where pool coverage binds
+    t = min(2048, n)
+    rng = np.random.default_rng(seed)
+    sample = jnp.asarray(
+        np.sort(rng.choice(n, size=t, replace=False)), dtype=jnp.int32)
+    d2 = np.asarray(_neighbor_dist_profile(x, g, sample))
+    # floor: exact duplicates give d2=0; ratios need a scale-relative floor
+    floor = max(float(np.median(d2[:, -1])), 1e-30) * 1e-6
+    d2 = np.maximum(d2, floor)
+    ratios = d2[:, 1:] / d2[:, :-1]
+    jump = ratios.max(axis=1)
+    pos = ratios.argmax(axis=1) + 1  # in-clump neighbor count before the jump
+    clumpy = jump >= 4.0  # 2x in distance — well above gaussian concentration
+    frac = float(np.mean(clumpy))
+    if frac < 0.5:
+        logger.info("cagra seed_pool auto: no clump structure (%.0f%% of "
+                    "sampled rows show a >=4x neighbor-distance jump) — "
+                    "default pool", frac * 100)
+        return 0
+    s = float(np.median(pos[clumpy])) + 1.0  # + self
+    modes = n / s
+    pool = 1 << int(np.ceil(np.log2(max(2.0 * modes, 1.0))))
+    pool = int(min(max(pool, 0), 131072))
+    if pool <= 16384:
+        logger.info("cagra seed_pool auto: clump size ~%.0f → ~%.0f modes — "
+                    "default pool covers them", s, modes)
+        return 0
+    logger.info("cagra seed_pool auto: %.0f%% of rows jump >=4x at median "
+                "position %.0f → ~%.0f local modes → seed_pool_hint=%d",
+                frac * 100, s, modes, pool)
+    return pool
+
+
 def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIndex:
     """Full CAGRA build (reference: cagra::build, cagra.cuh)."""
     res = res or default_resources()
@@ -381,8 +477,9 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
         "cagra supports L2 metrics (reference parity), got %s", mt.name,
     )
     knn_graph = build_knn_graph(params, x, res=res)
+    hint = estimate_seed_pool(x, knn_graph, seed=params.seed)
     graph = optimize(knn_graph, params.graph_degree, res=res)
-    return CagraIndex(dataset=x, graph=graph, metric=mt)
+    return CagraIndex(dataset=x, graph=graph, metric=mt, seed_pool_hint=hint)
 
 
 # ---------------------------------------------------------------------------
@@ -514,9 +611,12 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     itopk = params.itopk_size
     max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
+    pool = int(params.seed_pool)
+    if pool < 0:  # auto: the build-time measured hint, else the r02 default
+        pool = int(index.seed_pool_hint) or 16384
     return _cagra_search(index, queries, as_key(params.seed), int(k),
                          int(itopk), int(max_iter),
-                         int(params.search_width), sqrt_out, int(params.seed_pool))
+                         int(params.search_width), sqrt_out, pool)
 
 
 def save(index: CagraIndex, path: str) -> None:
@@ -524,14 +624,20 @@ def save(index: CagraIndex, path: str) -> None:
     with open(path, "wb") as f:
         serialize_header(f, "cagra")
         serialize_scalar(f, int(index.metric))
+        serialize_scalar(f, int(index.seed_pool_hint))
         serialize_mdspan(f, index.dataset)
         serialize_mdspan(f, index.graph)
 
 
 def load(path: str, res: Resources | None = None) -> CagraIndex:
     with open(path, "rb") as f:
-        check_header(f, "cagra")
+        ver = check_header(f, "cagra")
         metric = DistanceType(deserialize_scalar(f))
+        # raft_tpu/4 added the measured seed_pool_hint; older files search
+        # with the default pool (correct, just not data-tuned)
+        hint = deserialize_scalar(f) if ver not in (
+            "raft_tpu/2", "raft_tpu/3") else 0
         dataset = jnp.asarray(deserialize_mdspan(f))
         graph = jnp.asarray(deserialize_mdspan(f))
-    return CagraIndex(dataset=dataset, graph=graph, metric=metric)
+    return CagraIndex(dataset=dataset, graph=graph, metric=metric,
+                      seed_pool_hint=hint)

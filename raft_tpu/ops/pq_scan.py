@@ -163,6 +163,20 @@ def pq_lut_scan(codes, lut, codes_lo=None, *, bt: int = 32,
             "lut must be (B, K, S) matching codes (B, cap, S)")
     expects(lut.shape[1] == (32 if codes_lo is not None else 16),
             "lut K must be 16 (single-stage) or 32 (split with codes_lo)")
+    # Mosaic requires the lane (last) dim be 128-aligned: pad S up to the
+    # next divisor of 128 (S < 128) or multiple of 128 (S > 128) with
+    # zero-valued LUT columns — pad lanes gather lut[0, pad] == 0 and add
+    # nothing to the sum, so scores are exact. (A raw S like 96 or 24,
+    # reachable via pq_bits=4 builds, would otherwise hit an opaque Mosaic
+    # lowering failure that interpret-mode tests cannot catch.)
+    if 128 % S != 0:
+        Sp = 1 << (S - 1).bit_length() if S < 128 else -(-S // 128) * 128
+        zpad = ((0, 0), (0, 0), (0, Sp - S))
+        codes = jnp.pad(codes, zpad)
+        if codes_lo is not None:
+            codes_lo = jnp.pad(codes_lo, zpad)
+        lut = jnp.pad(lut, zpad)
+        S = Sp
     pack = 128 // S if 128 % S == 0 else 1
     capP = -(-cap // pack)
     lanes = S * pack

@@ -418,6 +418,28 @@ class TestScanImpls:
             np.testing.assert_allclose(np.sort(d, 1), np.sort(d0, 1),
                                        rtol=1e-5, atol=1e-4, err_msg=impl)
 
+    @pytest.mark.parametrize("S", [24, 96, 192])
+    def test_odd_lane_widths_padded(self, S, monkeypatch):
+        """pq_dim values that neither divide nor are a multiple of 128 (e.g.
+        96, 24 — reachable via pq_bits=4 builds) must route through the
+        zero-LUT lane padding, not hand Mosaic a non-128-aligned lane dim
+        (r04 advisor finding). Direct kernel parity vs the numpy sum."""
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.pq_scan import pq_lut_scan
+
+        rng = np.random.default_rng(0)
+        B, cap = 3, 40
+        codes = rng.integers(0, 16, (B, cap, S), dtype=np.int8)
+        lut = rng.normal(size=(B, 16, S)).astype(np.float32)
+        got = np.asarray(pq_lut_scan(
+            jnp.asarray(codes), jnp.asarray(lut), interpret=True))
+        want = np.take_along_axis(
+            lut[:, :, None, :].transpose(0, 2, 1, 3),
+            codes[:, :, None, :].astype(np.int64), axis=2
+        )[:, :, 0, :].sum(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
     def test_narrow_stage_guard(self, data):
         from raft_tpu.core import RaftError
 

@@ -38,6 +38,47 @@ def test_load_is_much_faster_than_build(tmp_path, rng):
     np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+def test_warmup_entry_point(tmp_path):
+    """raft_tpu.warmup must run the real build+search pipeline at the given
+    shapes under the persistent cache and report timings (VERDICT r4 #6 —
+    the AOT first-touch story; small shapes here, 1M measured in
+    BASELINE.md's cold/warm table). The warmup itself runs in a subprocess:
+    enable_compilation_cache permanently redirects this process's jax cache
+    config, and the cache dir is a tmp_path deleted after the test."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    cache = tmp_path / "warmcache"
+    code = f"""
+import sys
+sys.path.insert(0, {str(repo)!r})
+from raft_tpu.core.platform import force_virtual_cpu
+force_virtual_cpu(1)
+import raft_tpu
+from raft_tpu.neighbors import ivf_flat
+out = raft_tpu.warmup("ivf_flat", n=2000, d=16, queries=64,
+                      index_params=ivf_flat.IndexParams(n_lists=16, seed=0),
+                      cache_dir={str(cache)!r})
+assert out["build_s"] > 0 and out["search_s"] > 0, out
+import os
+assert os.path.isdir(out["cache_dir"]), out
+print("WARMUP_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=360)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WARMUP_OK" in r.stdout
+
+    # the kind guard needs no jax work and is safe in-process
+    import raft_tpu
+    from raft_tpu.core import RaftError
+
+    with pytest.raises(RaftError, match="unknown index kind"):
+        raft_tpu.warmup("flann", n=100, d=8)
+
+
 def test_enable_compilation_cache_populates_dir(tmp_path):
     """The cache helper must configure jax to persist entries to disk. Run in
     a subprocess so this process's jax config/caches are untouched."""
