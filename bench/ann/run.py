@@ -61,6 +61,22 @@ def load_dataset(spec: dict):
         syn = spec["synthetic"]
         rng = np.random.default_rng(syn.get("seed", 0))
         n_clusters = syn.get("clusters", 0)
+        if syn.get("dtype") == "uint8":
+            # BigANN-class byte descriptors (reference:
+            # cpp/bench/ann/conf/bigann-100M.json over .u8bin files):
+            # clustered integer vectors in [0, 255], kept uint8 end-to-end
+            # so the int8 storage/scoring path is what gets measured
+            dim = syn["dim"]
+            expects_clusters = max(n_clusters, 1)
+            centers = rng.integers(30, 226, (expects_clusters, dim))
+            std = syn.get("cluster_std", 12.0)
+
+            def draw_u8(count):
+                labels = rng.integers(0, expects_clusters, count)
+                x = centers[labels] + rng.normal(0, std, (count, dim))
+                return np.clip(np.rint(x), 0, 255).astype(np.uint8)
+
+            return draw_u8(syn["n"]), draw_u8(syn["n_queries"]), metric
         if n_clusters:
             dim = syn["dim"]
             centers = rng.random((n_clusters, dim), np.float32) * 10
@@ -112,8 +128,13 @@ def load_dataset(spec: dict):
         return base, queries, metric
     from raft_tpu.runtime import load_bin
 
-    base = load_bin(spec["base_file"]).astype(np.float32)
-    queries = load_bin(spec["query_file"]).astype(np.float32)
+    def native(arr):
+        # int8/uint8 files stay integer (the indexes take them first-class:
+        # int8 list storage + s8 MXU scoring); floats normalize to f32
+        return arr if arr.dtype in (np.int8, np.uint8) else arr.astype(np.float32)
+
+    base = native(load_bin(spec["base_file"]))
+    queries = native(load_bin(spec["query_file"]))
     if "subset_size" in spec:
         base = base[: spec["subset_size"]]
     return base, queries, metric

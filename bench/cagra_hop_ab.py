@@ -1,0 +1,84 @@
+"""Interleaved A/B of the fused CAGRA hop kernel vs the XLA hop loop at 1M
+(VERDICT r4 #1 done-bar: driver-protocol 1M itopk=32, >= 1.5x in the same
+process at recall parity).
+
+Protocol matches bench.py's cagra_1m_itopk32 row: isotropic clustered 1M x
+128, 10k-query sets, best-of wall time with host materialization, variants
+round-robin in one process. Run on the TPU host:
+
+    python bench/cagra_hop_ab.py [--rounds 4] [--itopk 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--itopk", type=int, default=32)
+    ap.add_argument("--lid", action="store_true",
+                    help="use the SIFT-class LID dataset instead of isotropic")
+    args = ap.parse_args()
+
+    from raft_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import numpy as np
+
+    import bench as drv
+    from raft_tpu.neighbors import cagra
+
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    dataset, qsets = (drv._make_lid_1m() if args.lid else drv._make_1m())
+    jax.block_until_ready([dataset] + qsets)
+    gt = drv._ground_truth(dataset, qsets[-1][:1000])
+
+    t0 = time.perf_counter()
+    idx = cagra.build(cagra.IndexParams(), dataset)
+    jax.block_until_ready(idx.graph)
+    print(f"build {time.perf_counter() - t0:.1f}s "
+          f"(seed_pool_hint={idx.seed_pool_hint})", file=sys.stderr)
+
+    m = qsets[0].shape[0]
+    variants = {
+        "xla": cagra.SearchParams(itopk_size=args.itopk, hop_impl="xla"),
+        "fused": cagra.SearchParams(itopk_size=args.itopk, hop_impl="fused"),
+    }
+    outs = {}
+    for name, sp in variants.items():
+        out = cagra.search(sp, idx, qsets[0], 10)  # compile + warm
+        np.asarray(out[0])
+        outs[name] = out
+
+    times = {name: [] for name in variants}
+    for r in range(args.rounds):
+        for name, sp in variants.items():
+            best = float("inf")
+            for qs in qsets[1:]:
+                t0 = time.perf_counter()
+                out = cagra.search(sp, idx, qs, 10)
+                np.asarray(out[0])
+                best = min(best, time.perf_counter() - t0)
+                outs[name] = out
+            times[name].append(m / best)
+
+    for name in variants:
+        rec = drv._recall(np.asarray(outs[name][1])[:1000], gt)
+        qps = times[name]
+        print(f"{name:6s} recall {rec:.4f}  QPS "
+              f"{[f'{v/1e3:.1f}k' for v in qps]}")
+    sp_ratio = [f / x for f, x in zip(times["fused"], times["xla"])]
+    print(f"fused/xla per round: {[f'{r:.3f}' for r in sp_ratio]}  "
+          f"best-ratio {max(times['fused'])/max(times['xla']):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -38,16 +38,17 @@ __all__ = [
 #   raft_tpu/2: version header added; ivf_flat/ivf_pq carry split_factor.
 #   raft_tpu/3: ivf_pq carries pq_split + list_consts (nibble-split pq8).
 #   raft_tpu/4: cagra carries seed_pool_hint (measured search autotune).
-SERIALIZATION_VERSION = "raft_tpu/4"
+#   raft_tpu/5: ivf_flat carries data_kind (int8/uint8 list storage).
+SERIALIZATION_VERSION = "raft_tpu/5"
 
-# Older versions each tag can still READ (only cagra's layout changed in
-# raft_tpu/4, only ivf_pq's in raft_tpu/3 — bumping the global version must
-# not force rebuilds of unchanged formats; loaders branch on the returned
-# version where a field was added).
+# Older versions each tag can still READ (only ivf_flat's layout changed in
+# raft_tpu/5, cagra's in /4, ivf_pq's in /3 — bumping the global version
+# must not force rebuilds of unchanged formats; loaders branch on the
+# returned version where a field was added).
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
-    "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3"}),
-    "ivf_pq": frozenset({"raft_tpu/3"}),
-    "cagra": frozenset({"raft_tpu/2", "raft_tpu/3"}),
+    "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4"}),
+    "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4"}),
+    "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4"}),
 }
 
 

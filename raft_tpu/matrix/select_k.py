@@ -62,6 +62,10 @@ def select_k(values, k: int, select_min: bool = True, indices=None):
     # floats: the kernel ranks after an f32 cast, so under jax_enable_x64 a
     # float64 row whose entries differ only beyond f32 precision would be
     # silently misranked vs the exact lax.top_k path.
+    # k in (64, 256] (the r05 bitonic-merge wide path, ops/topk.py) is kept
+    # OFF this dispatch until the bench/topk_wide_ab.py A/B on hardware
+    # justifies it — the gate below must only widen with a measurement
+    # (BASELINE.md "Round-5 wide-k selector study")
     if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 64
             and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         from ..ops.topk import topk_pallas

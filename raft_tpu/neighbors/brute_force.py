@@ -38,6 +38,46 @@ _FUSED_L2 = {
 }
 
 
+def _as_signed(x):
+    """uint8 -> int8 by the -128 shift (L2-invariant; ip callers correct via
+    row_bias); int8 passes through. The reference instantiates int8_t and
+    uint8_t kernels separately (cpp/src/neighbors/*_int8_t_*.cu /
+    *_uint8_t_*.cu); on TPU the MXU's integer path is s8 x s8, so uint8
+    rides the same kernel shifted."""
+    if x.dtype == jnp.uint8:
+        return (x.astype(jnp.int16) - 128).astype(jnp.int8)
+    return x
+
+
+def _bf_knn_s8(dataset, queries, k, metric, keep_mask):
+    """int8 MXU dispatch (~2x bf16 peak, 1-byte operand DMAs). Distances are
+    EXACT integers for d <= ~340 (see ops/fused_knn mode='s8')."""
+    from ..ops.fused_knn import fused_backend_ok, fused_knn
+
+    _, interpret = fused_backend_ok()
+    shifted = dataset.dtype == jnp.uint8
+    ds = _as_signed(dataset)
+    qs = _as_signed(queries)
+    if metric in _FUSED_L2:
+        return fused_knn(ds, qs, k, metric="l2", mode="s8",
+                         keep_mask=keep_mask, sqrt=_FUSED_L2[metric],
+                         interpret=interpret)
+    # inner product: q·v = q'·v' + 128·Σv' + 128·Σq' + 128²·d for shifted
+    # operands — the Σv' term rides the kernel's row-bias operand, the
+    # per-query constant is added outside
+    if not shifted:
+        return fused_knn(ds, qs, k, metric="ip", mode="s8",
+                         keep_mask=keep_mask, interpret=interpret)
+    d = dataset.shape[1]
+    row_bias = -128.0 * jnp.sum(ds.astype(jnp.float32), axis=1)
+    sim, idx = fused_knn(ds, qs, k, metric="ip", mode="s8",
+                         keep_mask=keep_mask, row_bias=row_bias,
+                         interpret=interpret)
+    qconst = (128.0 * jnp.sum(qs.astype(jnp.float32), axis=1, keepdims=True)
+              + 16384.0 * d)
+    return jnp.where(jnp.isinf(sim), sim, sim + qconst), idx
+
+
 def _fused_eligible(metric, k, n, d, mode, compute):
     from ..ops.fused_knn import fused_backend_ok, shapes_eligible
 
@@ -140,6 +180,13 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     (single-pass MXU contraction — same neighbor ordering in all but
     razor-thin margins, several times the GEMM throughput).
 
+    int8/uint8 datasets are first-class (reference: the int8_t/uint8_t
+    brute-force instantiations): integer dataset+query pairs dispatch to the
+    s8 x s8 -> s32 MXU kernel (~2x bf16 peak, 1-byte gathers) with EXACT
+    integer distances; uint8 rides the same kernel shifted by -128 (L2 is
+    shift-invariant, inner products are bias-corrected). ``compute="int8"``
+    asserts intent; integer inputs use this path by default.
+
     On TPU, L2/inner-product/cosine searches with k ≤ 64, n ≥ 4096 and
     64 ≤ d ≤ 4096 dispatch to the fused Pallas kernel (ops/fused_knn.py;
     smaller d would mostly multiply 128-lane padding) — same neighbor sets;
@@ -155,13 +202,42 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     n = dataset.shape[0]
     expects(0 < k <= n, "k=%d must be in (0, n=%d]", k, n)
     expects(mode in ("exact", "approx"), "mode must be 'exact' or 'approx', got %r", mode)
-    expects(compute in _PRECISIONS or compute == "float32x3",
+    expects(compute in _PRECISIONS or compute in ("float32x3", "int8"),
             "compute must be one of %s, got %r",
-            sorted(_PRECISIONS) + ["float32x3"], compute)
+            sorted(_PRECISIONS) + ["float32x3", "int8"], compute)
     mt = resolve_metric(metric)
     keep_mask = resolve_filter(sample_filter)
     if keep_mask is not None:
         expects(keep_mask.shape == (n,), "sample filter must cover all %d dataset rows", n)
+    int_dtypes = (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8))
+    expects(compute != "int8"
+            or (dataset.dtype in int_dtypes and queries.dtype in int_dtypes),
+            "compute='int8' requires int8/uint8 dataset AND queries, got "
+            "%s/%s — the s8 MXU path has no meaning for float inputs",
+            dataset.dtype, queries.dtype)
+    if dataset.dtype in int_dtypes or queries.dtype in int_dtypes:
+        # int8/uint8 ingestion (reference: brute_force int8_t/uint8_t
+        # instantiations). Integer pairs route to the s8 MXU kernel —
+        # distances are exact integers at these dtypes — and anything the
+        # kernel can't take (mixed-precision pairs, cosine, tiny shapes,
+        # no TPU) falls back to the f32 pipeline, which is also exact for
+        # 8-bit integer values.
+        if dataset.dtype in int_dtypes and queries.dtype in int_dtypes:
+            expects(dataset.dtype == queries.dtype,
+                    "int8/uint8 dataset and queries must share a dtype "
+                    "(mixing signed and shifted domains is a data error), "
+                    "got %s/%s", dataset.dtype, queries.dtype)
+            from ..ops.fused_knn import fused_backend_ok, shapes_eligible
+
+            if (mode == "exact" and compute in ("float32", "int8")
+                    and (mt in _FUSED_L2 or mt == DistanceType.InnerProduct)
+                    and fused_backend_ok()[0]
+                    and shapes_eligible(n, dataset.shape[1], int(k))):
+                return _bf_knn_s8(dataset, queries, int(k), mt, keep_mask)
+        dataset = dataset.astype(jnp.float32)
+        queries = queries.astype(jnp.float32)
+    if compute == "int8":
+        compute = "float32"  # explicit int8 on a non-integer/fallback path
     if _fused_eligible(mt, int(k), n, dataset.shape[1], mode, compute):
         return _bf_knn_fused(dataset, queries, int(k), mt, compute, keep_mask)
     if compute == "float32x3":

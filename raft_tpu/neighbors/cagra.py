@@ -123,6 +123,16 @@ class SearchParams:
     #   0 → plain random entries (reference behavior).
     #   >0 → explicit pool size, honored as-is.
     seed_pool: int = -1
+    # hop-loop implementation (r05, VERDICT r4 #1):
+    #   "auto"  → the fused Pallas hop kernel (ops/cagra_hop.py) on TPU when
+    #     eligible (search_width=1, itopk+degree <= 128), else the XLA loop.
+    #     The r04 profile localized ~0.46 us/query of the search in ~20
+    #     op-at-a-time XLA passes over beam state per hop; the fused kernel
+    #     runs scoring+dedup+merge+pick as ONE launch with beam state
+    #     VMEM-resident, keeping the two gathers in XLA where the r04
+    #     head-to-head measured them fastest.
+    #   "xla" / "fused" → forced (fused validates eligibility).
+    hop_impl: str = "auto"
     # RNG seed (int / RngState / raw key) for the seed-pool draw (ref
     # search_params :118 rand_xor_mask). Determinism contract: the same
     # (seed, index, queries, params) always searches the same sampled pool,
@@ -489,11 +499,12 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out", "seed_pool"),
+    static_argnames=("k", "itopk", "max_iter", "search_width", "sqrt_out",
+                     "seed_pool", "hop_impl"),
 )
 def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
                   max_iter: int, search_width: int, sqrt_out: bool,
-                  seed_pool: int = 16384):
+                  seed_pool: int = 16384, hop_impl: str = "xla"):
     n, d = index.dataset.shape
     m = queries.shape[0]
     deg = index.graph_degree
@@ -552,6 +563,53 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
 
     beam_ids, beam_d, beam_visited = dedup_sort(beam_ids, beam_d, beam_visited)
 
+    if hop_impl == "fused":
+        # one Pallas launch per hop: scoring+dedup+merge+pick with beam state
+        # VMEM-resident (VERDICT r4 #1; ops/cagra_hop.py docstring has the
+        # profile-driven rationale). Beam distances carry the FULL ||v-q||^2
+        # inside this loop (the kernel scores directly), so +qn moves to init.
+        from ..ops.cagra_hop import cagra_hop, hop_backend_ok
+
+        _, interpret = hop_backend_ok()
+        qn = jnp.sum(qf * qf, axis=1, keepdims=True)
+        P = 128
+        bd = jnp.full((m, P), jnp.inf, jnp.float32
+                      ).at[:, :itopk].set(
+                          jnp.maximum(beam_d[:, :itopk] + qn, 0.0))
+        bi = jnp.full((m, P), -1, jnp.int32).at[:, :itopk].set(
+            beam_ids[:, :itopk])
+        bv = jnp.ones((m, P), jnp.int32).at[:, :itopk].set(
+            beam_visited[:, :itopk].astype(jnp.int32))
+        # prime: candidates masked (valid=0) — merge is a no-op re-sort, and
+        # the kernel emits the first hop's pick
+        zero_nbrs = jnp.full((m, deg), -1, jnp.int32)
+        zero_vecs = jnp.zeros((m, deg, d), jnp.float32)
+        bd, bi, bv, pick, nocand = cagra_hop(
+            qf, bd, bi, bv, zero_nbrs, zero_vecs,
+            jnp.zeros((m, 1), jnp.int32), itopk, deg, interpret=interpret)
+
+        def fcond(state):
+            _, _, _, _, nocand, it = state
+            return jnp.logical_and(it < max_iter,
+                                   jnp.logical_not(jnp.all(nocand > 0)))
+
+        def fbody(state):
+            bd, bi, bv, pick, nocand, it = state
+            safe = jnp.minimum(pick[:, 0], n - 1)
+            nbrs = index.graph[safe]                     # (m, deg)
+            vecs = data[jnp.maximum(nbrs, 0)].astype(jnp.float32)
+            bd, bi, bv, pick, nocand = cagra_hop(
+                qf, bd, bi, bv, nbrs, vecs, 1 - nocand, itopk, deg,
+                interpret=interpret)
+            return bd, bi, bv, pick, nocand, it + 1
+
+        bd, bi, bv, _, _, _ = lax.while_loop(
+            fcond, fbody, (bd, bi, bv, pick, nocand, 0))
+        out_d = jnp.maximum(bd[:, :k], 0.0)
+        if sqrt_out:
+            out_d = jnp.sqrt(out_d)
+        return out_d, bi[:, :k]
+
     def cond(state):
         _, _, visited, it, done = state
         return jnp.logical_and(it < max_iter, jnp.logical_not(done))
@@ -600,6 +658,35 @@ def resolve_max_iterations(params: SearchParams) -> int:
         params.itopk_size // max(params.search_width, 1) + 10)
 
 
+def resolve_seed_pool(params: SearchParams, hint: int = 0) -> int:
+    """seed_pool=-1 (auto) → the index's measured hint, else the r02 default.
+    Shared by the single-chip and distributed drivers so -1 never leaks into
+    _cagra_search (where a negative pool would silently mean random entries)."""
+    pool = int(params.seed_pool)
+    if pool < 0:
+        pool = int(hint) or 16384
+    return pool
+
+
+def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int) -> str:
+    """Validate + resolve ``params.hop_impl`` (shared by the single-chip and
+    distributed searches — same eligibility rules, same clear errors)."""
+    from ..ops.cagra_hop import hop_backend_ok, hop_shapes_eligible
+
+    expects(params.hop_impl in ("auto", "xla", "fused"),
+            "hop_impl must be 'auto', 'xla' or 'fused', got %r",
+            params.hop_impl)
+    eligible = (hop_backend_ok()[0] and hop_shapes_eligible(
+        params.itopk_size, graph_degree, params.search_width, dim))
+    if params.hop_impl == "auto":
+        return "fused" if eligible else "xla"
+    if params.hop_impl == "fused":
+        expects(eligible, "hop_impl='fused' needs search_width=1, "
+                "itopk+graph_degree <= 128 and a TPU backend (or "
+                "RAFT_TPU_CAGRA_HOP_INTERPRET=1 for tests)")
+    return params.hop_impl
+
+
 @auto_convert_output
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
@@ -611,12 +698,11 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     itopk = params.itopk_size
     max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
-    pool = int(params.seed_pool)
-    if pool < 0:  # auto: the build-time measured hint, else the r02 default
-        pool = int(index.seed_pool_hint) or 16384
+    pool = resolve_seed_pool(params, index.seed_pool_hint)
+    impl = resolve_hop_impl(params, index.graph_degree, index.dim)
     return _cagra_search(index, queries, as_key(params.seed), int(k),
                          int(itopk), int(max_iter),
-                         int(params.search_width), sqrt_out, pool)
+                         int(params.search_width), sqrt_out, pool, impl)
 
 
 def save(index: CagraIndex, path: str) -> None:

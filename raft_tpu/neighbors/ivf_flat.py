@@ -56,11 +56,19 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     add_data_on_build: bool = True
     seed: int = 0
-    # storage dtype of list vectors: "bfloat16" halves the scan's HBM gather
-    # traffic (the 1M-scale bottleneck) at negligible recall cost; norms stay
-    # f32 and scoring accumulates in f32 on the MXU. The reference's analogue
-    # is its int8/fp16 ivf_flat instantiations (cpp/src ivf_flat int8_t/half).
-    list_dtype: str = "float32"
+    # storage dtype of list vectors (reference: the float/half/int8_t/uint8_t
+    # ivf_flat instantiations, cpp/src/neighbors/ivf_flat_build_*.cu):
+    #   "auto"     — float32 for float data, int8 for int8/uint8 data.
+    #   "bfloat16" — halves the scan's HBM gather traffic (the 1M-scale
+    #                bottleneck) at negligible recall cost; norms stay f32,
+    #                scoring accumulates in f32 on the MXU.
+    #   "int8"     — RAW 8-bit data stored as-is (uint8 shifted by -128 into
+    #                the s8 domain; L2 is shift-invariant): 1-byte gathers
+    #                (half of bf16) and s8 x s8 -> s32 MXU scoring with
+    #                EXACT integer partial scores. Requires int8/uint8 input
+    #                (quantized storage for float data is IVF-PQ's job).
+    #   "float32"  — float storage for any input.
+    list_dtype: str = "auto"
     # capacity bound for sub-list splitting, as a multiple of the mean list
     # size (see _list_utils.bound_capacity). 1.3 measured +24% search QPS at
     # identical 0.9999 recall vs 2.0 at 1M x 128 (the scan is bound by
@@ -90,6 +98,10 @@ class IvfFlatIndex:
     # build-time capacity policy; extend() inherits it so the no-split /
     # split behavior chosen at build survives incremental additions
     split_factor: float = 1.3
+    # what the list vectors ARE: "float32"/"bfloat16" (float storage),
+    # "int8" (signed bytes as given), "uint8" (bytes stored shifted by
+    # -128 into the s8 domain — queries shift the same way at search)
+    data_kind: str = "float32"
 
     @property
     def n_lists(self) -> int:
@@ -117,13 +129,15 @@ class IvfFlatIndex:
     def tree_flatten(self):
         return (
             (self.centers, self.list_data, self.list_ids, self.list_norms, self.list_sizes),
-            (self.metric, self.split_factor),
+            (self.metric, self.split_factor, self.data_kind),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        metric, split_factor = aux
-        return cls(*children, metric=metric, split_factor=split_factor)
+        metric, split_factor, kind = (aux if len(aux) == 3
+                                      else (*aux, "float32"))
+        return cls(*children, metric=metric, split_factor=split_factor,
+                   data_kind=kind)
 
 
 @functools.partial(jax.jit, static_argnames=("n_lists", "capacity"))
@@ -140,6 +154,56 @@ def _fill_lists(x, ids, labels, n_lists: int, capacity: int):
     xf = x.astype(jnp.float32)
     norms = norms.at[labels, pos].set(jnp.sum(xf * xf, axis=1))
     return data, idbuf, norms, counts.astype(jnp.int32)
+
+
+def _coerce_queries(data_kind: str, queries):
+    """Move queries into an index's storage domain (shared by the
+    single-chip and distributed searches): integer queries must match the
+    index's dtype and shift with it; float queries against a shifted-uint8
+    index shift by -128 (L2-invariant)."""
+    if data_kind not in ("int8", "uint8"):
+        return queries
+    if queries.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8)):
+        expects(str(queries.dtype) == data_kind,
+                "this index stores %s vectors; got %s queries",
+                data_kind, queries.dtype)
+        from .brute_force import _as_signed
+
+        return _as_signed(queries).astype(jnp.float32)
+    if data_kind == "uint8":
+        return queries.astype(jnp.float32) - 128.0
+    return queries
+
+
+def _resolve_storage(list_dtype: str, x, mt: DistanceType):
+    """Resolve the list_dtype policy for a dataset: returns (data_kind,
+    storage-domain x, f32 working view). Shared by the single-chip build and
+    the distributed build (parallel/ivf.py) so both ingest int8/uint8
+    identically."""
+    expects(list_dtype in ("auto", "float32", "bfloat16", "int8"),
+            "list_dtype must be 'auto', 'float32', 'bfloat16' or 'int8', "
+            "got %r", list_dtype)
+    int_in = x.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8))
+    ld = list_dtype
+    if ld == "auto":
+        ld = "int8" if int_in else "float32"
+    if ld == "int8":
+        expects(int_in, "list_dtype='int8' stores raw 8-bit data; got a %s "
+                "dataset (quantized storage for float data is IVF-PQ's "
+                "job)", x.dtype)
+        # uint8 under IP is NOT shift-invariant and the per-vector sum
+        # correction is not stored; int8 IP needs no shift and is exact
+        expects(mt != DistanceType.InnerProduct or x.dtype == jnp.int8,
+                "uint8 + inner_product is unsupported in int8 storage "
+                "(the -128 shift changes inner products); use "
+                "list_dtype='float32'")
+        kind = str(x.dtype)
+        from .brute_force import _as_signed
+
+        x = _as_signed(x)  # all further work in the shifted s8 domain
+        return kind, x, x.astype(jnp.float32)
+    x = x.astype(jnp.float32) if int_in else x
+    return ld, x, x.astype(jnp.float32)
 
 
 def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlatIndex:
@@ -164,17 +228,17 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
         mt.name,
     )
 
-    expects(params.list_dtype in ("float32", "bfloat16"),
-            "list_dtype must be 'float32' or 'bfloat16', got %r", params.list_dtype)
+    kind, x, xf = _resolve_storage(params.list_dtype, x, mt)
     max_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
     train_metric = "inner_product" if mt == DistanceType.InnerProduct else "sqeuclidean"
     kb = KMeansBalancedParams(
         n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
         max_train_points=min(max_train, n),
     )
-    centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
+    centers = kmeans_balanced.fit(kb, xf, params.n_lists, res=res)
 
-    storage = jnp.bfloat16 if params.list_dtype == "bfloat16" else x.dtype
+    storage = {"bfloat16": jnp.bfloat16, "int8": jnp.int8,
+               "uint8": jnp.int8}.get(kind, x.dtype)
 
     if not params.add_data_on_build:
         cap = 8
@@ -186,10 +250,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
             list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
             metric=mt,
             split_factor=params.split_factor,
+            data_kind=kind,
         )
         return empty
 
-    return extend(
+    return _extend_signed(
         IvfFlatIndex(
             centers=centers,
             list_data=jnp.zeros((params.n_lists, 0, d), storage),
@@ -198,6 +263,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
             list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
             metric=mt,
             split_factor=params.split_factor,
+            data_kind=kind,
         ),
         x,
         jnp.arange(n, dtype=jnp.int32),
@@ -212,6 +278,25 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
     Capacity is data-dependent, so extend re-packs lists host-orchestrated:
     existing + new vectors are re-scattered into a freshly sized padded array
     (the reference reallocates lists too — ivf_list.hpp resize)."""
+    x = jnp.asarray(new_vectors)
+    if index.data_kind in ("int8", "uint8"):
+        # 8-bit indexes take vectors in the index's ORIGINAL dtype; a plain
+        # astype would wrap uint8 values mod 256 instead of shifting them
+        expects(str(x.dtype) == index.data_kind,
+                "this index stores %s vectors; got %s", index.data_kind,
+                x.dtype)
+        from .brute_force import _as_signed
+
+        x = _as_signed(x)
+    return _extend_signed(index, x, new_ids, res=res,
+                          split_factor=split_factor)
+
+
+def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
+                   res: Resources | None = None,
+                   split_factor: float | None = None) -> IvfFlatIndex:
+    """extend() after domain conversion: vectors already live in the index's
+    storage domain (s8-shifted for uint8 kinds)."""
     res = res or default_resources()
     # storage dtype travels with the index (build's list_dtype choice)
     x = jnp.asarray(new_vectors).astype(index.list_data.dtype)
@@ -223,7 +308,8 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
-    labels = assign_to_lists(x, index.centers, index.metric, tile)
+    xa = x.astype(jnp.float32) if x.dtype == jnp.int8 else x
+    labels = assign_to_lists(xa, index.centers, index.metric, tile)
 
     # merge with existing list contents (flatten old lists back to rows)
     if index.capacity > 0 and index.size > 0:
@@ -245,7 +331,8 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
     data, idbuf, norms, sizes = _fill_lists(x, new_ids, labels, n_lists, capacity)
-    return IvfFlatIndex(centers, data, idbuf, norms, sizes, index.metric, sf)
+    return IvfFlatIndex(centers, data, idbuf, norms, sizes, index.metric, sf,
+                        index.data_kind)
 
 
 @functools.partial(
@@ -288,6 +375,14 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
             # einsum is no faster (13.0k vs 15.4k QPS — the scan is bound by
             # the padded-list gather, not the matvec) and rounding the query
             # to bf16 costs recall (0.9697 vs 0.9756).
+            # int8 lists ride the same upcast: the gather (the measured
+            # bottleneck) moves 1 byte/dim — half of bf16 — and the f32
+            # convert fuses into the dot's operand pipeline. Scoring is
+            # EXACT for 8-bit values (every intermediate is an integer
+            # below 2^24). A native s8 x s8 -> s32 einsum was tried and
+            # REJECTED: on TPU the batched 4-d einsum decays to an inexact
+            # bf16 lowering (measured 2.8% distance error, r05); only the
+            # Pallas fused-kNN kernel's 2-d dot takes the true s8 MXU path.
             dots = jnp.einsum(
                 "td,tpcd->tpc", q, vecs.astype(jnp.float32),
                 precision=lax.Precision.HIGHEST,
@@ -340,6 +435,7 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
+    queries = _coerce_queries(index.data_kind, queries)
     expects(index.capacity > 0, "index is empty")
     if not isinstance(index.list_sizes, jax.core.Tracer):
         expects(index.size > 0, "index is empty")
@@ -351,7 +447,8 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
         k, n_probes, index.capacity,
     )
 
-    # gathered vectors (f32) + norms + scores per slot; x2 for XLA temporaries
+    # gathered vectors (f32 staged) + norms + scores per slot; x2 for XLA
+    # temporaries — the f32 staging bound holds for all storage dtypes
     query_tile, probe_chunk = plan_search_tiles(
         m, n_probes, int(k), index.capacity,
         bytes_per_probe_row=2 * index.capacity * (index.dim * 4 + 8),
@@ -373,6 +470,7 @@ def save(index: IvfFlatIndex, path: str) -> None:
         serialize_header(f, "ivf_flat")
         serialize_scalar(f, int(index.metric))
         serialize_scalar(f, float(index.split_factor))
+        serialize_scalar(f, index.data_kind)
         serialize_mdspan(f, index.centers)
         serialize_mdspan(f, index.list_data)
         serialize_mdspan(f, index.list_ids)
@@ -383,12 +481,22 @@ def save(index: IvfFlatIndex, path: str) -> None:
 def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
     """Deserialize (reference: ivf_flat_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        check_header(f, "ivf_flat")
+        ver = check_header(f, "ivf_flat")
         metric = DistanceType(deserialize_scalar(f))
         split_factor = float(deserialize_scalar(f))
+        # raft_tpu/5 added data_kind (int8/uint8 storage); older files —
+        # including /4, whose global bump was for cagra and wrote ivf_flat
+        # in the /3 layout — hold only float kinds, recoverable from the
+        # stored dtype
+        kind = (deserialize_scalar(f)
+                if ver not in ("raft_tpu/2", "raft_tpu/3", "raft_tpu/4")
+                else None)
         centers = jnp.asarray(deserialize_mdspan(f))
         data = jnp.asarray(deserialize_mdspan(f))
         ids = jnp.asarray(deserialize_mdspan(f))
         norms = jnp.asarray(deserialize_mdspan(f))
         sizes = jnp.asarray(deserialize_mdspan(f))
-    return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor)
+    if kind is None:
+        kind = "bfloat16" if data.dtype == jnp.bfloat16 else "float32"
+    return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor,
+                        kind)

@@ -1,0 +1,218 @@
+"""Fused CAGRA hop kernel: the whole per-hop beam update in ONE Pallas pass.
+
+The r04 hop study (BASELINE.md "Round-4 CAGRA hop study" + addendum)
+decomposed the 1M batch-synchronous search into ~0.27 us/query/hop of
+expansion scoring (the vector gather — which XLA's gather engine serves at
+~60 GB/s effective on overlapping beam frontiers, 15x the isolated per-row
+DMA rate, so an in-kernel `make_async_copy` gather CANNOT win) and
+~0.46 us/query of "everything else": ~20 op-at-a-time XLA passes over the
+(m, itopk+deg) beam-state arrays per hop, none individually hot — dispatch
+and small-op latency, not bandwidth. This kernel attacks exactly that term,
+the way the reference's persistent SINGLE_CTA kernel keeps its itopk queue
+in registers/smem (detail/cagra/search_single_cta.cuh): the two gathers
+(graph row, vectors) stay in XLA where they are fastest, and EVERYTHING
+between them — candidate scoring, dedup against the beam, the
+beam-merge selection, visited bookkeeping, and the next hop's pick —
+runs in one kernel launch with all beam state resident in VMEM.
+
+Per hop the XLA level does exactly three ops: graph-row gather, vector
+gather, this kernel. Beam state crosses HBM once per hop instead of ~20
+times, and 20 op dispatches collapse into 1.
+
+Layout: beam arrays are (m, 128)-padded (lanes >= itopk carry the empty
+sentinel) so every in-kernel op is full-lane-width; the merge pool packs
+[beam | candidates | pad] into the same 128 lanes with static slice writes.
+Selection is ascending iterative extraction with lowest-id tie-breaks
+(matching the XLA path's two-sort dedup semantics); candidate ids already
+present in the beam are masked before the merge (the beam's copy of a node
+carries the identical exact distance, so keeping it is equivalent).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["cagra_hop", "hop_backend_ok", "hop_shapes_eligible"]
+
+_POOL = 128               # merge pool lanes: itopk + deg must fit
+_BIG = 2 ** 30
+_INF = jnp.inf
+
+
+def hop_backend_ok():
+    """(may_run, interpret): Mosaic on TPU, or interpret mode opted into for
+    tests via RAFT_TPU_CAGRA_HOP_INTERPRET=1 (same contract as fused_knn)."""
+    import os
+
+    on_tpu = jax.default_backend() == "tpu"
+    interpret_ok = os.environ.get(
+        "RAFT_TPU_CAGRA_HOP_INTERPRET", "").lower() in ("1", "true", "yes")
+    return on_tpu or interpret_ok, not on_tpu
+
+
+def hop_shapes_eligible(itopk: int, deg: int, width: int, d: int) -> bool:
+    """The fused hop supports the single-pick beam (search_width=1 — the
+    default and the only width the r04 profile measured) with the merge pool
+    inside one 128-lane register row."""
+    return width == 1 and itopk + deg <= _POOL and itopk >= 1 and d <= 4096
+
+
+def _make_hop_kernel(itopk: int, deg: int, qt: int, dp: int,
+                     profile: str = "full"):
+    """``profile`` carves phases out for the in-kernel profile
+    (bench/cagra_hop_profile.py): "full", "noscore" (skip the distance
+    computation), "nodedup" (skip the beam-membership masks), "nomerge"
+    (skip dedup+extraction — beam passes through, pick still computed)."""
+    def kernel(q_ref, bd_ref, bi_ref, bv_ref, nbr_ref, vec_ref, valid_ref,
+               nbd_ref, nbi_ref, nbv_ref, pick_ref, nocand_ref,
+               pd_ref, pi_ref, pv_ref):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (qt, _POOL), 1)
+
+        # ---- candidate scoring: ||v - q||^2, (qt, deg) ----
+        nbr = nbr_ref[...]                   # (qt, deg) int32
+        if profile == "noscore":
+            nd = jnp.abs(nbr).astype(jnp.float32)  # fake but well-formed
+        else:
+            q = q_ref[...]                   # (qt, dp)
+            vecs = vec_ref[...]              # (qt, deg, dp)
+            diff = vecs - q[:, None, :]
+            nd = jnp.sum(diff * diff, axis=-1)   # (qt, deg)
+        ok = (nbr >= 0) & (valid_ref[...] > 0)          # (qt, deg) & (qt, 1)
+        nd = jnp.where(ok, nd, _INF)
+
+        # ---- dedup vs the beam: a candidate already in the beam carries
+        # the identical exact distance there — drop the new copy ----
+        bi = bi_ref[...]                     # (qt, _POOL)
+        if profile == "nomerge":
+            nbd_ref[...] = bd_ref[...]
+            nbi_ref[...] = bi
+            nbv_ref[...] = bv_ref[...]
+            _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref,
+                       pick_ref, nocand_ref)
+            return
+        if profile != "nodedup":
+            for b in range(itopk):
+                nd = jnp.where(nbr == bi[:, b:b + 1], _INF, nd)
+
+        # ---- merge pool: [beam | candidates | +inf pad], one row ----
+        pd_ref[...] = bd_ref[...]
+        pi_ref[...] = bi
+        pv_ref[...] = bv_ref[...]
+        pd_ref[:, itopk:itopk + deg] = nd
+        pi_ref[:, itopk:itopk + deg] = nbr
+        pv_ref[:, itopk:itopk + deg] = jnp.zeros((qt, deg), jnp.int32)
+        pd_ref[:, itopk + deg:] = jnp.full((qt, _POOL - itopk - deg), _INF,
+                                           jnp.float32)
+        pi_ref[:, itopk + deg:] = jnp.full((qt, _POOL - itopk - deg), -1,
+                                           jnp.int32)
+        pv_ref[:, itopk + deg:] = jnp.ones((qt, _POOL - itopk - deg),
+                                           jnp.int32)
+
+        # ---- ascending extraction with lowest-id ties: the in-VMEM form of
+        # the XLA path's lexsort+sort dedup merge ----
+        nbd_ref[...] = jnp.full((qt, _POOL), _INF, jnp.float32)
+        nbi_ref[...] = jnp.full((qt, _POOL), -1, jnp.int32)
+        nbv_ref[...] = jnp.ones((qt, _POOL), jnp.int32)
+        for t in range(itopk):
+            pdv = pd_ref[...]
+            mn = jnp.min(pdv, axis=1, keepdims=True)
+            sel = pdv <= mn                          # winners incl. ties
+            amid = jnp.min(jnp.where(sel, pi_ref[...], _BIG), axis=1,
+                           keepdims=True)
+            hit = (pi_ref[...] == amid) & sel
+            wv = jnp.min(jnp.where(hit, pv_ref[...], _BIG), axis=1,
+                         keepdims=True)
+            nbd_ref[:, t] = mn[:, 0]
+            nbi_ref[:, t] = jnp.where(mn[:, 0] < _INF, amid[:, 0], -1)
+            nbv_ref[:, t] = jnp.minimum(wv[:, 0], 1)
+            # mask every copy of the chosen id (kills in-row duplicates too)
+            pd_ref[...] = jnp.where(pi_ref[...] == amid, _INF, pdv)
+
+        _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref,
+                   pick_ref, nocand_ref)
+
+    return kernel
+
+
+def _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref, pick_ref,
+               nocand_ref):
+    """Next pick: best unvisited in the itopk window; mark it visited."""
+    nbd = nbd_ref[...]
+    nbv = nbv_ref[...]
+    cd = jnp.where((nbv > 0) | (lane >= itopk), _INF, nbd)
+    mn = jnp.min(cd, axis=1, keepdims=True)
+    nocand = (mn >= _INF).astype(jnp.int32)
+    sel = cd <= mn
+    pick_id = jnp.min(jnp.where(sel, nbi_ref[...], _BIG), axis=1,
+                      keepdims=True)
+    nbv_ref[...] = jnp.where(
+        (nbi_ref[...] == pick_id) & (nocand == 0), 1, nbv)
+    pick_ref[...] = jnp.clip(pick_id, 0, _BIG)
+    nocand_ref[...] = nocand
+
+
+@functools.partial(jax.jit, static_argnames=("itopk", "deg", "qt", "interpret",
+                                             "profile"))
+def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
+              itopk: int, deg: int, qt: int = 128, interpret: bool = False,
+              profile: str = "full"):
+    """One fused CAGRA hop over the whole query batch.
+
+    ``queries`` (m, d) f32; ``beam_d/beam_i/beam_v`` (m, 128) padded beam
+    state (distances f32 ascending, ids i32, visited i32; lanes >= itopk are
+    +inf/-1/1); ``nbrs`` (m, deg) i32 candidate ids (-1 = none); ``vecs``
+    (m, deg, d) their vectors; ``valid`` (m, 1) i32 — 0 masks this hop's
+    candidates (used to prime the loop and after convergence).
+
+    Returns (beam_d, beam_i, beam_v, pick (m, 1) i32 clipped >= 0,
+    no_cand (m, 1) i32).
+    """
+    m, d = queries.shape
+    dp = -(-d // 128) * 128
+    mp = -(-m // qt) * qt
+    pad_rows = mp - m
+
+    def prow(x, fill=0):
+        return jnp.pad(x, ((0, pad_rows),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill) if pad_rows else x
+
+    qp = prow(jnp.pad(queries, ((0, 0), (0, dp - d))) if dp > d else queries)
+    vp = prow(jnp.pad(vecs, ((0, 0), (0, 0), (0, dp - d)))
+              if dp > d else vecs)
+    args = (qp, prow(beam_d, _INF), prow(beam_i, -1), prow(beam_v, 1),
+            prow(nbrs, -1), vp, prow(valid))
+    grid = (mp // qt,)
+    spec2 = lambda w: pl.BlockSpec((qt, w), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        _make_hop_kernel(itopk, deg, qt, dp, profile),
+        grid=grid,
+        in_specs=[spec2(dp), spec2(_POOL), spec2(_POOL), spec2(_POOL),
+                  spec2(deg),
+                  pl.BlockSpec((qt, deg, dp), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  spec2(1)],
+        out_specs=[spec2(_POOL), spec2(_POOL), spec2(_POOL), spec2(1),
+                   spec2(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, _POOL), jnp.float32),
+            jax.ShapeDtypeStruct((mp, _POOL), jnp.int32),
+            jax.ShapeDtypeStruct((mp, _POOL), jnp.int32),
+            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((qt, _POOL), jnp.float32),   # merge pool distances
+            pltpu.VMEM((qt, _POOL), jnp.int32),     # merge pool ids
+            pltpu.VMEM((qt, _POOL), jnp.int32),     # merge pool visited
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(*args)
+    return tuple(o[:m] for o in outs)

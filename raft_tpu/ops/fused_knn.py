@@ -48,6 +48,14 @@ Modes:
             sets match f32 except where two distances differ by < ~1e-6 rel.
   "bf16"  — single-pass bf16 contraction. Fastest; set recall ~0.98 on
             worst-case (uniform) data, higher on clustered data.
+  "s8"    — int8 operands, s8 x s8 -> s32 MXU contraction (~2x bf16 peak,
+            1-byte operand DMAs). For int8/uint8 datasets (the reference's
+            ivf_flat/brute-force int8_t/uint8_t instantiations,
+            cpp/src/neighbors/*_int8_t_*.cu): callers pass SHIFTED signed
+            values (uint8 - 128 — L2 is shift-invariant; inner-product
+            callers fold the 128-sum correction into the yn operand).
+            EXACT distances when 3*128^2*d < 2^24 (d <= ~340): every
+            intermediate is an integer below f32's exact range.
 
 Ties: equal scores resolve to the lowest dataset index, matching lax.top_k.
 
@@ -114,6 +122,11 @@ def _extract_topk_ids(v, ids, k):
 def _scores(q, y, mode):
     """MXU contraction q @ y.T in the requested precision mode."""
     dn = (((1,), (1,)), ((), ()))
+    if mode == "s8":
+        # int8 MXU path: s8 x s8 -> s32 (double bf16 peak), f32 at the end
+        # for the extraction machinery's sentinel arithmetic
+        return jax.lax.dot_general(
+            q, y, dn, preferred_element_type=jnp.int32).astype(jnp.float32)
     if mode == "bf16":
         return jax.lax.dot_general(
             q.astype(jnp.bfloat16), y.astype(jnp.bfloat16), dn,
@@ -203,7 +216,7 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
     # inside the kernel was costing more than the narrower MXU pass saved
     # (measured bf16 SLOWER than f32 with in-kernel casts), and bf16 operands
     # also halve the per-step DMA bytes
-    io_t = jnp.bfloat16 if mode == "bf16" else jnp.float32
+    io_t = {"bf16": jnp.bfloat16, "s8": jnp.int8}.get(mode, jnp.float32)
     ds = jnp.pad(dataset.astype(io_t), ((0, n_pad - n), (0, d_pad - d)))
     qs = jnp.pad(queries.astype(io_t), ((0, m_pad - m), (0, d_pad - d)))
     base = yn if yn is not None else jnp.zeros((n,), jnp.float32)
@@ -251,13 +264,20 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
 
 
 def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
-              sqrt=False, qt=128, nblk=4096, interpret=False):
+              sqrt=False, row_bias=None, qt=128, nblk=4096, interpret=False):
     """Exact brute-force kNN via the fused Pallas kernel.
 
     ``metric``: "l2" (squared euclidean; ``sqrt=True`` for euclidean) or
     "ip" (inner product; larger = closer, like the reference's
     DistanceType::InnerProduct contract).  Cosine is "ip" over pre-normalized
     inputs (the caller normalizes, as distance/pairwise._cosine does).
+
+    ``mode="s8"`` requires int8 inputs (uint8 callers shift by -128 first —
+    L2 is shift-invariant; see brute_force._as_signed). ``row_bias`` (n,)
+    f32 is subtracted from every row's score before selection — the hook ip
+    callers use to restore uint8 inner products from shifted operands
+    (q·v = q'·v' + 128·Σv' + const(q), where the Σv' term is the row bias
+    with sign flipped).
 
     Returns (distances (m, k) f32, indices (m, k) int32).  Rows with fewer
     than k admissible dataset points (under ``keep_mask``) get -1 indices and
@@ -273,8 +293,16 @@ def fused_knn(dataset, queries, k, *, metric="l2", mode="f32", keep_mask=None,
     # score scratch must fit VMEM alongside the operand blocks
     expects(nblk % 128 == 0 and 128 <= nblk <= 16384,
             "nblk must be a multiple of 128 lanes in [128, 16384]")
+    if mode == "s8":
+        expects(dataset.dtype == jnp.int8 and queries.dtype == jnp.int8,
+                "mode='s8' requires int8 operands (shift uint8 by -128 "
+                "first), got %s/%s", dataset.dtype, queries.dtype)
     l2 = metric == "l2"
     yn = (jnp.sum(dataset.astype(jnp.float32) ** 2, axis=1) if l2 else None)
+    if row_bias is not None:
+        rb = jnp.asarray(row_bias, jnp.float32)
+        expects(rb.shape == (n,), "row_bias must be (n,)")
+        yn = rb if yn is None else yn + rb
     keep = None if keep_mask is None else jnp.asarray(keep_mask).astype(bool)
     # shrink the dataset block if the feature dim would blow the VMEM budget
     # (in whole 128-lane segments so the invariant above survives the shrink)

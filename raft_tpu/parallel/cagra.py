@@ -29,7 +29,8 @@ from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
 from ..random.rng import as_key
 from ..neighbors.cagra import (CagraIndex, IndexParams, SearchParams, _cagra_search,
-                               resolve_max_iterations)
+                               resolve_hop_impl, resolve_max_iterations,
+                               resolve_seed_pool)
 from ..neighbors.cagra import build as build_single
 
 __all__ = ["ShardedCagraIndex", "build", "search"]
@@ -102,14 +103,20 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
     max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded,
                                 DistanceType.L2SqrtUnexpanded)
-    seed_pool = int(params.seed_pool)  # _cagra_search clamps to shard rows
+    # shared resolution with the single-chip driver: -1 (auto) must not
+    # leak into _cagra_search (a negative pool silently means random
+    # entries), and hop_impl picks the fused Pallas hop when eligible.
+    # Per-shard indexes carry no seed_pool_hint; auto falls to the default.
+    seed_pool = resolve_seed_pool(params)  # _cagra_search clamps to shard rows
+    hop_impl = resolve_hop_impl(
+        params, index.graph.shape[-1], index.dim)
     inner = index.metric == DistanceType.InnerProduct
 
     def step(data, graph, q):
         shard = CagraIndex(dataset=data[0], graph=graph[0], metric=index.metric)
         d_loc, i_loc = _cagra_search(shard, q, as_key(params.seed), k, itopk,
                                      max_iter, int(params.search_width),
-                                     sqrt_out, seed_pool)
+                                     sqrt_out, seed_pool, hop_impl)
         i_glob = jnp.where(i_loc >= 0,
                            i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
         d_all = comms.allgather(d_loc)

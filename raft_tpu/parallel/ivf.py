@@ -1,21 +1,34 @@
-"""Multi-chip IVF-Flat search: shard the inverted lists, probe locally,
-merge candidates over ICI.
+"""Multi-chip IVF: distributed BUILD (no chip ever holds the full dataset)
+and distributed SEARCH (shard the inverted lists, probe locally, merge
+candidates over ICI).
 
 The reference leaves multi-GPU ANN serving to users composing raft::comms
 with per-shard indexes and knn_merge_parts (SURVEY.md §5; the cuML/cuGraph
-pattern over docs/source/using_comms.rst). Here it is a first-class driver:
-the padded list arrays (and their coarse centers) are sharded along
-``n_lists`` over the mesh axis; each chip ranks its own local centers and
-scans its local top-``n_probes`` lists, then one all_gather + select_k merge
-produces global results. Per-shard probing means each chip's scan work is
-identical (batch-synchronous, no load imbalance) and the effective probe
-count is ``size x n_probes`` local lists rather than a global top-n_probes.
+pattern over docs/source/using_comms.rst). Here both halves are first-class
+drivers:
+
+- **build/build_pq/extend** (VERDICT r4 #3): dataset rows sharded over the
+  mesh axis; coarse centers via the psum-EM balanced k-means (the cuML MNMG
+  k-means pattern, docs/source/using_comms.rst:1-40); every per-row step
+  (assignment, residual encode, norms) runs shard-local; the padded list
+  arrays are then materialized ALREADY SHARDED BY LISTS with one
+  S-step psum loop whose working set is one list-block (L/S lists) — at no
+  point does any chip hold the full dataset or the full index.
+- **search/search_pq**: the padded list arrays (and their coarse centers)
+  are sharded along ``n_lists``; each chip ranks its own local centers and
+  scans its local top-``n_probes`` lists, then one all_gather + select_k
+  merge produces global results. Per-shard probing means each chip's scan
+  work is identical (batch-synchronous, no load imbalance) and the effective
+  probe count is ``size x n_probes`` local lists. A build()-produced index
+  feeds search() without any resharding gather: the arrays already carry the
+  list sharding the search expects.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..comms.comms import Comms, replicated, shard_along
@@ -24,7 +37,7 @@ from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
 from ..neighbors.ivf_flat import IvfFlatIndex, SearchParams, _ivf_search
 
-__all__ = ["search", "search_pq"]
+__all__ = ["build", "build_pq", "extend", "search", "search_pq"]
 
 
 def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
@@ -63,6 +76,8 @@ def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
             [index.list_sizes, jnp.zeros((pad,), jnp.int32)]
         ),
         metric=index.metric,
+        split_factor=index.split_factor,
+        data_kind=index.data_kind,
     )
 
 
@@ -77,7 +92,9 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
 
     Returns replicated (distances (m, k), global ids (m, k)).
     """
-    queries = jnp.asarray(queries)
+    from ..neighbors.ivf_flat import _coerce_queries
+
+    queries = _coerce_queries(index.data_kind, jnp.asarray(queries))
     size = comms.size()
     index = _pad_lists_to_multiple(index, size)
     L = index.n_lists
@@ -87,7 +104,8 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
     inner = index.metric == DistanceType.InnerProduct
 
     def step(centers, data, ids, norms, sizes, q):
-        shard = IvfFlatIndex(centers, data, ids, norms, sizes, index.metric)
+        shard = IvfFlatIndex(centers, data, ids, norms, sizes, index.metric,
+                             index.split_factor, index.data_kind)
         d_loc, i_loc = _ivf_search(
             shard, q, n_probes, k,
             query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
@@ -257,3 +275,392 @@ def search_pq(comms: Comms, params, index, queries, k: int,
         out_specs=(P(), P()),
     )
     return jax.jit(fn)(*args)
+
+
+# ---------------------------------------------------------------------------
+# distributed build / extend (VERDICT r4 #3)
+# ---------------------------------------------------------------------------
+#
+# Reference pattern: the MNMG builds the docs tell users to compose from
+# raft::comms collectives (/root/reference/docs/source/using_comms.rst:1-40;
+# the cuML MNMG k-means psum-EM over kmeans_balanced.cuh). The TPU shape:
+#
+#   phase 1 (one shard_map program): balanced psum-EM — per-shard fused-1-NN
+#     assignment, psum center sums/counts, balancing re-seeds drawn from a
+#     pooled (allgathered) subsample so every shard computes IDENTICAL
+#     replicated centers; returns centers + sharded labels + global counts.
+#   phase 2 (host): static capacity from the global counts (no sub-list
+#     splitting in the distributed build — balanced k-means bounds skew, and
+#     a data-dependent list count would break the static sharding layout).
+#   phase 3 (one shard_map program): materialize the padded list arrays
+#     ALREADY SHARDED BY LISTS. Cross-shard write positions come from an
+#     exclusive prefix over the allgathered per-shard list counts; the
+#     arrays are filled one list-block (L/S lists) at a time — scatter local
+#     rows into the block, psum, owner keeps — so the peak per-chip working
+#     set is one block (~dataset/S), never the dataset or the index.
+#
+# The produced index's arrays carry exactly the list sharding search()
+# expects, so build -> search composes with no resharding gather.
+
+
+def _pooled_balanced_centers(comms: Comms, x_shard, keys, L: int,
+                             n_iters: int, small_ratio: float, n_global: int,
+                             sub: int, inner: bool, tile: int):
+    """Distributed balanced EM (inside shard_map). Returns replicated
+    (centers, labels_shard, global_counts). Deterministic: all replicated
+    math consumes identical inputs (allgathered pool, psum'd stats)."""
+    from ..cluster.kmeans_balanced import _assign_labels
+
+    xf = x_shard.astype(jnp.float32)
+    ksub = jax.random.fold_in(keys[0], comms.rank())
+    idx = jax.random.choice(ksub, x_shard.shape[0], (sub,), replace=False)
+    pool = comms.allgather(jnp.take(xf, idx, axis=0), tiled=True)  # (S*sub, d)
+    init_idx = jax.random.choice(keys[1], pool.shape[0], (L,), replace=False)
+    centers0 = jnp.take(pool, init_idx, axis=0)
+    ptile = min(tile, pool.shape[0])
+
+    def body(i, carry):
+        centers, key = carry
+        labels = _assign_labels(x_shard, centers, tile, inner)
+        onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
+        sums = comms.allreduce(onehot @ xf)
+        counts = comms.allreduce(jnp.sum(onehot, axis=1))
+        centers = jnp.where(counts[:, None] > 0,
+                            sums / jnp.maximum(counts, 1.0)[:, None], centers)
+        # balancing (single-chip _balanced_em's pool trick, already sized
+        # for this): re-seed small clusters from the replicated pooled
+        # subsample, weighted by crowdedness, Gumbel top-k for distinctness
+        key, kc = jax.random.split(key)
+        pool_w = counts[_assign_labels(pool, centers, ptile, inner)]
+        logits = jnp.log(jnp.maximum(pool_w, 1e-6))
+        gumbel = -jnp.log(-jnp.log(jax.random.uniform(
+            kc, (pool.shape[0],), minval=1e-20, maxval=1.0)))
+        repl = pool[lax.top_k(logits + gumbel, L)[1]]
+        small = counts < (n_global / L) * small_ratio
+        centers = jnp.where(small[:, None], repl, centers)
+        return centers, key
+
+    centers, _ = lax.fori_loop(0, n_iters, body, (centers0, keys[2]))
+    # final sharpening pass without balancing so centers are true means
+    labels = _assign_labels(x_shard, centers, tile, inner)
+    onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
+    sums = comms.allreduce(onehot @ xf)
+    counts = comms.allreduce(jnp.sum(onehot, axis=1))
+    centers = jnp.where(counts[:, None] > 0,
+                        sums / jnp.maximum(counts, 1.0)[:, None], centers)
+    labels = _assign_labels(x_shard, centers, tile, inner)
+    gcounts = comms.allreduce(jnp.bincount(labels, length=L)).astype(jnp.int32)
+    return centers, labels.astype(jnp.int32), gcounts
+
+
+def _global_positions(comms: Comms, labels, L: int, base=None):
+    """Write position of each local row inside its global list: exclusive
+    prefix of the allgathered per-shard list counts + within-shard rank
+    (+ optional replicated per-list base, used by extend)."""
+    from ..neighbors._list_utils import list_positions
+
+    lc = jnp.bincount(labels, length=L)
+    all_counts = comms.allgather(lc)  # (S, L) replicated
+    offs = jnp.cumsum(all_counts, axis=0) - all_counts
+    my_off = offs[comms.rank()]  # (L,)
+    pos, _ = list_positions(labels, L)
+    gpos = my_off[labels].astype(jnp.int32) + pos
+    if base is not None:
+        gpos = gpos + base[labels].astype(jnp.int32)
+    return gpos
+
+
+def _fill_blocks(comms: Comms, payloads, labels, gpos, L: int, cap: int):
+    """Materialize list-sharded padded arrays one list-block at a time.
+
+    ``payloads``: list of (values (n_shard, ...), f32/i32 scatter dtype).
+    Returns one (L/S, cap, ...) block per payload (out_spec P(axis) makes it
+    the caller's (L, cap, ...) list-sharded global array). Peak per-chip
+    working set: ONE block per payload — the no-full-dataset invariant."""
+    S = comms.size()
+    Lb = L // S
+    rank = comms.rank()
+
+    def block(b, accs):
+        lo = b * Lb
+        in_blk = (labels >= lo) & (labels < lo + Lb)
+        # OOB sentinel (Lb / cap) + mode="drop": rows outside the block are
+        # dropped by the scatter, and never wrap (negative indices would)
+        lloc = jnp.where(in_blk, labels - lo, Lb)
+        p = jnp.where(in_blk, gpos, cap)
+        out = []
+        for (vals, dt), acc in zip(payloads, accs):
+            blk = jnp.zeros((Lb, cap) + vals.shape[1:], dt)
+            blk = blk.at[lloc, p].set(vals.astype(dt), mode="drop")
+            blk = comms.allreduce(blk)
+            out.append(jnp.where(rank == b, blk, acc))
+        return tuple(out)
+
+    zeros = tuple(jnp.zeros((Lb, cap) + v.shape[1:], dt) for v, dt in payloads)
+    return lax.fori_loop(0, S, block, zeros)
+
+
+def _build_capacity(gcounts, extra=0) -> int:
+    import numpy as np
+
+    from ..neighbors._list_utils import round_up
+
+    return round_up(max(int(np.asarray(gcounts).max()) + extra, 8), 8)
+
+
+def build(comms: Comms, params, dataset, res=None) -> IvfFlatIndex:
+    """Distributed IVF-Flat build: dataset rows sharded over ``comms.axis``,
+    index lists sharded the way :func:`search` consumes them. ``params`` is
+    :class:`raft_tpu.neighbors.ivf_flat.IndexParams` (list_dtype honored,
+    incl. int8/uint8 ingestion; ``split_factor`` is ignored — the
+    distributed build does not split hot lists, see module docstring)."""
+    from ..distance.pairwise import _choose_tile
+    from ..neighbors.ivf_flat import _resolve_storage
+    from ..distance.types import resolve_metric
+
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, d)")
+    n, d = x.shape
+    S = comms.size()
+    expects(n % S == 0, "dataset rows (%d) must divide the mesh axis (%d); "
+            "pad first", n, S)
+    L = params.n_lists
+    expects(L % S == 0, "n_lists (%d) must divide the mesh axis (%d)", L, S)
+    expects(L <= n, "n_lists > n_samples")
+    mt = resolve_metric(params.metric)
+    kind, x, _ = _resolve_storage(params.list_dtype, x, mt)
+    storage = x.dtype
+    inner = mt == DistanceType.InnerProduct
+    mesh, axis = comms.mesh, comms.axis
+    shard_rows = n // S
+    sub = min(max(8 * L // S, 64), shard_rows)
+    tile = _choose_tile(shard_rows, L, 1, 1 << 28)
+
+    def phase1(x_shard, keys):
+        return _pooled_balanced_centers(
+            comms, x_shard, keys, L, params.kmeans_n_iters, 0.25, n, sub,
+            inner, tile)
+
+    keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
+    xs = shard_along(mesh, axis, x)
+    centers, labels, gcounts = jax.jit(comms.shard_map(
+        phase1, in_specs=(P(axis), P()),
+        out_specs=(P(), P(axis), P())))(xs, keys)
+    cap = _build_capacity(gcounts)
+
+    def phase3(x_shard, lab, ids):
+        xf = x_shard.astype(jnp.float32)
+        gpos = _global_positions(comms, lab, L)
+        data, idb, nrm = _fill_blocks(
+            comms,
+            [(xf, jnp.float32), (ids + 1, jnp.int32),
+             (jnp.sum(xf * xf, axis=1), jnp.float32)],
+            lab, gpos, L, cap)
+        idb = idb - 1  # 0 (additive identity) back to the -1 empty sentinel
+        nrm = jnp.where(idb < 0, jnp.inf, nrm)
+        return data.astype(storage), idb, nrm
+
+    ids = shard_along(mesh, axis, jnp.arange(n, dtype=jnp.int32))
+    data, idb, nrm = jax.jit(comms.shard_map(
+        phase3, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis))))(xs, labels, ids)
+    return IvfFlatIndex(
+        centers=centers, list_data=data, list_ids=idb, list_norms=nrm,
+        list_sizes=gcounts, metric=mt, split_factor=params.split_factor,
+        data_kind=kind)
+
+
+def extend(comms: Comms, index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
+    """Distributed IVF-Flat extend: new rows sharded over the mesh axis are
+    assigned and appended shard-locally; old list contents never leave their
+    owning chip (they are re-padded in place to the grown capacity)."""
+    from ..distance.pairwise import _choose_tile
+    from ..neighbors._list_utils import assign_to_lists
+    from ..neighbors.brute_force import _as_signed
+
+    x = jnp.asarray(new_vectors)
+    S = comms.size()
+    expects(x.ndim == 2 and x.shape[1] == index.dim, "vector dim mismatch")
+    expects(x.shape[0] % S == 0, "new rows (%d) must divide the mesh axis "
+            "(%d); pad first", x.shape[0], S)
+    L = index.n_lists
+    expects(L % S == 0, "index n_lists (%d) must divide the mesh axis (%d) "
+            "— was it built by parallel.ivf.build?", L, S)
+    if index.data_kind in ("int8", "uint8"):
+        expects(str(x.dtype) == index.data_kind,
+                "this index stores %s vectors; got %s", index.data_kind, x.dtype)
+        x = _as_signed(x)
+    else:
+        x = x.astype(index.list_data.dtype)
+    n_new = x.shape[0]
+    if new_ids is None:
+        new_ids = index.size + jnp.arange(n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+    mesh, axis = comms.mesh, comms.axis
+    tile = _choose_tile(n_new // S, L, 1, 1 << 28)
+
+    def assign(x_shard, centers):
+        xa = (x_shard.astype(jnp.float32)
+              if x_shard.dtype == jnp.int8 else x_shard)
+        lab = assign_to_lists(xa, centers, index.metric, tile)
+        return lab, comms.allreduce(jnp.bincount(lab, length=L)).astype(jnp.int32)
+
+    xs = shard_along(mesh, axis, x)
+    labels, new_counts = jax.jit(comms.shard_map(
+        assign, in_specs=(P(axis), P()), out_specs=(P(axis), P())))(
+        xs, replicated(mesh, index.centers))
+    import numpy as np
+
+    new_sizes = np.asarray(index.list_sizes) + np.asarray(new_counts)
+    cap = _build_capacity(new_sizes, extra=0)
+    old_cap = index.capacity
+    storage = index.list_data.dtype
+
+    def phase3(x_shard, lab, ids, old_data, old_ids, old_norms, sizes):
+        xf = x_shard.astype(jnp.float32)
+        gpos = _global_positions(comms, lab, L, base=sizes)
+        data, idb, nrm = _fill_blocks(
+            comms,
+            [(xf, jnp.float32), (ids + 1, jnp.int32),
+             (jnp.sum(xf * xf, axis=1), jnp.float32)],
+            lab, gpos, L, cap)
+        idb = idb - 1
+        # graft old list contents back in: slots below the old sizes belong
+        # to the resident data, slots at/above them to the new psum'd rows
+        grow = ((0, 0), (0, cap - old_cap), (0, 0))
+        od = jnp.pad(old_data.astype(jnp.float32), grow)
+        oi = jnp.pad(old_ids, grow[:2], constant_values=-1)
+        on = jnp.pad(old_norms, grow[:2], constant_values=jnp.inf)
+        keep_old = oi >= 0
+        data = jnp.where(keep_old[..., None], od, data)
+        idb = jnp.where(keep_old, oi, idb)
+        nrm = jnp.where(idb < 0, jnp.inf, jnp.where(keep_old, on, nrm))
+        return data.astype(storage), idb, nrm
+
+    ids = shard_along(mesh, axis, new_ids)
+    data, idb, nrm = jax.jit(comms.shard_map(
+        phase3,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(axis), P(axis), P(axis))))(
+        xs, labels, ids,
+        shard_along(mesh, axis, index.list_data),
+        shard_along(mesh, axis, index.list_ids),
+        shard_along(mesh, axis, index.list_norms),
+        replicated(mesh, index.list_sizes))
+    return IvfFlatIndex(
+        centers=index.centers, list_data=data, list_ids=idb, list_norms=nrm,
+        list_sizes=jnp.asarray(new_sizes, jnp.int32), metric=index.metric,
+        split_factor=index.split_factor, data_kind=index.data_kind)
+
+
+def build_pq(comms: Comms, params, dataset, res=None):
+    """Distributed IVF-PQ build (``params`` =
+    :class:`raft_tpu.neighbors.ivf_pq.IndexParams`): same three phases as
+    :func:`build`, plus replicated codebook training on a pooled residual
+    subsample between them, and a shard-local encode feeding the list fill.
+    Restrictions vs the single-chip build: per-subspace codebooks only
+    ("auto" resolves to per_subspace without the per-cluster trial) and no
+    sub-list splitting."""
+    from ..distance.pairwise import _choose_tile
+    from ..distance.types import resolve_metric
+    from ..neighbors import ivf_pq as pq_mod
+    from ..random.rng import as_key
+
+    x = jnp.asarray(dataset)
+    expects(x.ndim == 2, "dataset must be (n, d)")
+    n, d = x.shape
+    S = comms.size()
+    expects(n % S == 0, "dataset rows (%d) must divide the mesh axis (%d); "
+            "pad first", n, S)
+    L = params.n_lists
+    expects(L % S == 0, "n_lists (%d) must divide the mesh axis (%d)", L, S)
+    mt = resolve_metric(params.metric)
+    expects(params.codebook_kind in ("auto", "per_subspace"),
+            "the distributed build trains per-subspace codebooks "
+            "(codebook_kind=%r is single-chip only)", params.codebook_kind)
+    pq_dim = params.pq_dim or pq_mod._default_pq_dim(d, params.pq_bits)
+    pq_len = -(-d // pq_dim)
+    d_rot = pq_dim * pq_len
+    n_codes = 1 << params.pq_bits
+    split_pref = (params.pq8_split if params.pq8_split is not None
+                  else mt != DistanceType.InnerProduct)
+    split = params.pq_bits == 8 and split_pref
+    inner = mt == DistanceType.InnerProduct
+    mesh, axis = comms.mesh, comms.axis
+    shard_rows = n // S
+    sub = min(max(8 * L // S, 64), shard_rows)
+    tile = _choose_tile(shard_rows, L, 1, 1 << 28)
+
+    # phase 1: coarse centers (identical machinery to the flat build)
+    def phase1(x_shard, keys):
+        return _pooled_balanced_centers(
+            comms, x_shard, keys, L, params.kmeans_n_iters, 0.25, n, sub,
+            inner, tile)
+
+    keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
+    xs = shard_along(mesh, axis, x)
+    centers, labels, gcounts = jax.jit(comms.shard_map(
+        phase1, in_specs=(P(axis), P()),
+        out_specs=(P(), P(axis), P())))(xs, keys)
+    cap = _build_capacity(gcounts)
+
+    # phase 2: rotation (host, deterministic from the seed — replicated
+    # constant) + replicated codebook training on a pooled residual sample
+    key = as_key(params.seed)
+    key, kr = jax.random.split(key)
+    rotation = pq_mod._make_rotation(kr, d_rot, d, params.force_random_rotation)
+    key, kc = jax.random.split(key)
+
+    def phase2(x_shard, lab, c, kk):
+        ksub = jax.random.fold_in(kk[0], comms.rank())
+        idx = jax.random.choice(ksub, x_shard.shape[0], (sub,), replace=False)
+        xt = jnp.take(x_shard.astype(jnp.float32), idx, axis=0)
+        lt = jnp.take(lab, idx, axis=0)
+        resid = (xt - jnp.take(c, lt, axis=0)) @ rotation.T
+        pool = comms.allgather(resid, tiled=True)  # (S*sub, d_rot) replicated
+        sub_pools = jnp.moveaxis(
+            pool.reshape(pool.shape[0], pq_dim, pq_len), 1, 0)
+        if split:
+            return pq_mod._train_split_codebooks(
+                sub_pools, kk[1], params.kmeans_n_iters)
+        return pq_mod._train_codebooks_batched(
+            sub_pools, kk[1], n_codes, params.kmeans_n_iters)
+
+    cb_keys = replicated(mesh, jnp.stack([keys[0], kc]))
+    codebooks = jax.jit(comms.shard_map(
+        phase2, in_specs=(P(axis), P(axis), P(), P()),
+        out_specs=P()))(xs, labels, centers, cb_keys)
+
+    # phase 3: shard-local encode + block fill
+    enc_cb_host = (pq_mod._composed_codebooks(codebooks) if split
+                   else codebooks)
+    consts_l2 = split and not inner
+
+    def phase3(x_shard, lab, ids, c, enc_cb, cb):
+        resid = ((x_shard.astype(jnp.float32) - jnp.take(c, lab, axis=0))
+                 @ rotation.T).reshape(x_shard.shape[0], pq_dim, pq_len)
+        codes = pq_mod._encode(resid, enc_cb, lab, per_cluster=False,
+                               tile=min(x_shard.shape[0], 8192))
+        gpos = _global_positions(comms, lab, L)
+        payloads = [(codes, jnp.int32), (ids + 1, jnp.int32)]
+        if consts_l2:
+            payloads.append(
+                (pq_mod._pq_cross_consts(codes, cb, lab, False), jnp.float32))
+        out = _fill_blocks(comms, payloads, lab, gpos, L, cap)
+        cbuf = (out[2] if consts_l2
+                else jnp.zeros((L // comms.size(), 0), jnp.float32))
+        return out[0].astype(jnp.uint8), out[1] - 1, cbuf
+
+    ids = shard_along(mesh, axis, jnp.arange(n, dtype=jnp.int32))
+    codes_arr, idb, cbuf = jax.jit(comms.shard_map(
+        phase3, in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(axis), P(axis), P(axis))))(
+        xs, labels, ids, centers, replicated(mesh, enc_cb_host),
+        replicated(mesh, codebooks))
+    return pq_mod.IvfPqIndex(
+        centers=centers, centers_rot=centers @ rotation.T, rotation=rotation,
+        codebooks=codebooks, list_codes=codes_arr, list_ids=idb,
+        list_sizes=gcounts, list_consts=cbuf, metric=mt,
+        codebook_kind="per_subspace", pq_bits=params.pq_bits,
+        split_factor=params.split_factor, pq_split=split)

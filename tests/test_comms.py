@@ -260,6 +260,152 @@ class TestDistributedIvfPq:
                                    rtol=1e-5)
 
 
+class TestDistributedIvfBuild:
+    """Distributed index BUILD (VERDICT r4 #3): no chip ever holds the full
+    dataset — coarse centers via psum-EM, shard-local encode, list-block
+    psum fill. Exhaustive probing of the built index is EXACT for L2, so
+    parity is vs the f64 ground truth (the dryrun asserts the same)."""
+
+    def test_flat_build_exhaustive_exact(self, comms, rng):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu import parallel
+
+        n, d, m, k = 2048, 16, 40, 8
+        x = rng.random((n, d)).astype(np.float32)
+        q = rng.random((m, d)).astype(np.float32)
+        idx = parallel.ivf.build(
+            comms, ivf_flat.IndexParams(n_lists=32, seed=0), x)
+        assert idx.n_lists == 32
+        assert int(np.asarray(idx.list_sizes).sum()) == n
+        # every dataset row present exactly once
+        ids_stored = np.asarray(idx.list_ids)
+        assert sorted(ids_stored[ids_stored >= 0].tolist()) == list(range(n))
+        # distributed search of the sharded index (no gather: lists divide)
+        dists, ids = parallel.ivf.search(
+            comms, ivf_flat.SearchParams(n_probes=32 // comms.size()),
+            idx, q, k)
+        d2 = ((q[:, None, :].astype(np.float64) - x[None]) ** 2).sum(-1)
+        want = np.sort(d2, 1)[:, :k]
+        np.testing.assert_allclose(np.sort(np.asarray(dists), 1), want,
+                                   atol=1e-3, rtol=1e-3)
+        # and the single-chip search consumes the same index directly
+        d1, i1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=32), idx, q, k)
+        np.testing.assert_allclose(np.sort(np.asarray(d1), 1), want,
+                                   atol=1e-3, rtol=1e-3)
+
+    def test_flat_extend(self, comms, rng):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu import parallel
+
+        n, d = 1024, 8
+        x = rng.random((2 * n, d)).astype(np.float32)
+        q = x[:16]
+        idx = parallel.ivf.build(
+            comms, ivf_flat.IndexParams(n_lists=16, seed=0), x[:n])
+        idx2 = parallel.ivf.extend(comms, idx, x[n:])
+        assert int(np.asarray(idx2.list_sizes).sum()) == 2 * n
+        ids_stored = np.asarray(idx2.list_ids)
+        assert sorted(ids_stored[ids_stored >= 0].tolist()) == list(range(2 * n))
+        dists, ids = parallel.ivf.search(
+            comms, ivf_flat.SearchParams(n_probes=2), idx2, q, 4)
+        d2 = ((q[:, None, :].astype(np.float64) - x[None]) ** 2).sum(-1)
+        want = np.sort(d2, 1)[:, :4]
+        np.testing.assert_allclose(np.sort(np.asarray(dists), 1), want,
+                                   atol=1e-3, rtol=1e-3)
+
+    def test_flat_build_uint8(self, comms, rng):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu import parallel
+
+        n, d = 1024, 16
+        x = rng.integers(0, 256, (n, d), dtype=np.uint8)
+        q = x[:20]
+        idx = parallel.ivf.build(
+            comms, ivf_flat.IndexParams(n_lists=16, seed=0), x)
+        assert idx.data_kind == "uint8"
+        dists, ids = parallel.ivf.search(
+            comms, ivf_flat.SearchParams(n_probes=2), idx, q, 4)
+        d2 = ((q[:, None, :].astype(np.float64)
+               - x[None].astype(np.float64)) ** 2).sum(-1)
+        want = np.sort(d2, 1)[:, :4]
+        np.testing.assert_allclose(np.sort(np.asarray(dists), 1), want,
+                                   atol=1e-3, rtol=1e-3)
+
+    def test_pq_build_recall(self, comms, rng):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu import parallel
+
+        # clustered data so PQ has signal; pq4 (16 codes) per_subspace
+        centers = rng.random((16, 16)).astype(np.float32) * 10
+        lab = rng.integers(0, 16, 2048)
+        x = (centers[lab] + 0.3 * rng.standard_normal((2048, 16))).astype(np.float32)
+        q = x[:32]
+        idx = parallel.ivf.build_pq(
+            comms, ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=4, seed=0), x)
+        assert int(np.asarray(idx.list_sizes).sum()) == 2048
+        full = sp_dist.cdist(q, x, "sqeuclidean")
+        gt = np.argsort(full, axis=1)[:, :5]
+
+        def rec(ids):
+            ids = np.asarray(ids)
+            return np.mean([len(set(ids[r]) & set(gt[r])) / 5 for r in range(32)])
+
+        # raw PQ recall must be at parity with a single-chip build of the
+        # same config on the same data (pq4 on this config is inherently
+        # coarse — the bar is the build, not the quantizer)
+        d_dist, i_dist = parallel.ivf.search_pq(
+            comms, ivf_pq.SearchParams(n_probes=2), idx, q, 5)
+        one = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=4, seed=0), x)
+        _, i_ref = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), one, q, 5)
+        assert rec(i_dist) > rec(i_ref) - 0.1, (rec(i_dist), rec(i_ref))
+        # and the standard refine pass tracks the single-chip build's
+        # refined operating point (absolute recall here is set by pq4's
+        # coarseness on this deliberately hard config, not by the build)
+        from raft_tpu.neighbors.refine import refine
+
+        _, cand = parallel.ivf.search_pq(
+            comms, ivf_pq.SearchParams(n_probes=2), idx, q, 20)
+        _, i_rf = refine(x, q, cand, 5)
+        _, cand1 = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), one, q, 20)
+        _, i_rf1 = refine(x, q, cand1, 5)
+        assert rec(i_rf) > rec(i_rf1) - 0.1, (rec(i_rf), rec(i_rf1))
+        assert rec(i_rf) > 0.6, rec(i_rf)
+        # single-chip search consumes the sharded-built index too
+        _, i_one = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, q, 5)
+        assert rec(i_one) > rec(i_ref) - 0.1, (rec(i_one), rec(i_ref))
+
+    def test_pq8_split_build(self, comms, rng):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu import parallel
+
+        x = rng.random((1024, 16)).astype(np.float32)
+        idx = parallel.ivf.build_pq(
+            comms, ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8, seed=0), x)
+        assert idx.pq_split
+        # L2 split indexes must carry per-vector cross-term consts
+        assert idx.list_consts.shape == idx.list_ids.shape
+        d, i = parallel.ivf.search_pq(
+            comms, ivf_pq.SearchParams(n_probes=2), idx, x[:8], 3)
+        i = np.asarray(i)
+        # self-search: the query itself must be found at the top
+        assert (i[:, 0] == np.arange(8)).mean() > 0.7
+
+    def test_build_guards(self, comms, rng):
+        from raft_tpu.core import RaftError
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu import parallel
+
+        with pytest.raises(RaftError, match="divide the mesh axis"):
+            parallel.ivf.build(
+                comms, ivf_flat.IndexParams(n_lists=16, seed=0),
+                rng.random((1001, 8)).astype(np.float32))
+        with pytest.raises(RaftError, match="n_lists"):
+            parallel.ivf.build(
+                comms, ivf_flat.IndexParams(n_lists=20, seed=0),
+                rng.random((1024, 8)).astype(np.float32))
+
+
 class TestDistributedCagra:
     def test_matches_exact(self, comms, rng):
         from raft_tpu.parallel import cagra as pcagra

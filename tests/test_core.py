@@ -151,27 +151,47 @@ class TestSerializationVersion:
         assert ivf_pq.load(p2).pq_bits == pq.pq_bits
 
     def test_unchanged_formats_read_previous_version(self, tmp_path, rng):
-        """raft_tpu/3 only changed ivf_pq's layout: ivf_flat files written
-        under the raft_tpu/2 header must still load (no collateral
-        rebuild), while a raft_tpu/2 ivf_pq header must fail."""
+        """Old-layout files must keep loading where the layout is compatible
+        (no collateral rebuilds when the global version bumps): ivf_flat
+        streams in the /3-era layout (no data_kind scalar — what both /3 and
+        /4 headers wrote; the /4 bump was cagra's) and an ivf_pq /3 file
+        (layout unchanged since) all load; an ivf_pq raft_tpu/2 header must
+        fail."""
         import jax.numpy as jnp
         from raft_tpu.core import RaftError
+        from raft_tpu.core.serialize import (serialize_mdspan, serialize_scalar)
         from raft_tpu.neighbors import ivf_flat, ivf_pq
 
         x = jnp.asarray(rng.random((256, 16), "float32"))
         idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x)
-        p = str(tmp_path / "v2.bin")
-        ivf_flat.save(idx, p)
-        raw = open(p, "rb").read()
-        assert raw.count(b"raft_tpu/3") == 1
-        open(p, "wb").write(raw.replace(b"raft_tpu/3", b"raft_tpu/2"))
-        assert ivf_flat.load(p).metric == idx.metric
+        # hand-write the pre-/5 ivf_flat layout: header, metric,
+        # split_factor, then the five mdspans — no data_kind scalar. The /4
+        # case is the REAL-WORLD one: every ivf_flat file saved between the
+        # /4 and /5 bumps has exactly this shape.
+        for old_ver in ("raft_tpu/3", "raft_tpu/4"):
+            p = str(tmp_path / f"{old_ver.replace('/', '_')}.bin")
+            with open(p, "wb") as f:
+                serialize_scalar(f, "ivf_flat")
+                serialize_scalar(f, old_ver)
+                serialize_scalar(f, int(idx.metric))
+                serialize_scalar(f, float(idx.split_factor))
+                for arr in (idx.centers, idx.list_data, idx.list_ids,
+                            idx.list_norms, idx.list_sizes):
+                    serialize_mdspan(f, arr)
+            loaded = ivf_flat.load(p)
+            assert loaded.metric == idx.metric
+            assert loaded.data_kind == "float32", old_ver  # from stored dtype
 
         pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, seed=0), x)
         p2 = str(tmp_path / "pqv2.bin")
         ivf_pq.save(pq, p2)
         raw2 = open(p2, "rb").read()
-        i0 = raw2.index(b"raft_tpu/3")
+        i0 = raw2.index(b"raft_tpu/5")
+        # /3 and /4 ivf_pq layouts == /5 layout: relabeled files must load
+        for old_ver in (b"raft_tpu/3", b"raft_tpu/4"):
+            open(p2, "wb").write(raw2[:i0] + old_ver + raw2[i0 + 10:])
+            assert ivf_pq.load(p2).pq_bits == pq.pq_bits
+        # /2 ivf_pq layout predates pq_split/list_consts: must fail clearly
         open(p2, "wb").write(raw2[:i0] + b"raft_tpu/2" + raw2[i0 + 10:])
         with pytest.raises(RaftError, match="unsupported ivf_pq index file format"):
             ivf_pq.load(p2)

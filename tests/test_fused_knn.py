@@ -128,6 +128,76 @@ def test_keep_mask_fewer_than_k():
     assert set(di[0, :4]) == {0, 1, 2, 3}
 
 
+class TestInt8:
+    """int8/uint8 ingestion (VERDICT r4 #2; reference: the int8_t/uint8_t
+    brute-force instantiations). At d=72 every intermediate is an integer
+    below f32's exact range, so the s8 kernel must match the f32 pipeline
+    BITWISE, not just to tolerance."""
+
+    @pytest.fixture(scope="class")
+    def idata(self):
+        rng = np.random.default_rng(11)
+        xu = rng.integers(0, 256, (N, D), dtype=np.uint8)
+        qu = rng.integers(0, 256, (M, D), dtype=np.uint8)
+        return xu, qu
+
+    @pytest.mark.parametrize("dt", [np.int8, np.uint8])
+    def test_l2_exact_vs_f32(self, idata, dt):
+        xu, qu = idata
+        x = xu.astype(dt) if dt == np.uint8 else (
+            xu.astype(np.int16) - 128).astype(np.int8)
+        q = qu.astype(dt) if dt == np.uint8 else (
+            qu.astype(np.int16) - 128).astype(np.int8)
+        dv, di = knn(jnp.asarray(x), jnp.asarray(q), K)  # s8 dispatch
+        rd, ri = _bf_knn(jnp.asarray(x.astype(np.float32)),
+                         jnp.asarray(q.astype(np.float32)),
+                         K, DistanceType.L2Expanded, 2.0, 300, 300)
+        assert_knn_equiv(dv, di, rd, ri, rtol=0, atol=0)
+
+    @pytest.mark.parametrize("dt", [np.int8, np.uint8])
+    def test_inner_product_exact(self, idata, dt):
+        xu, qu = idata
+        x = xu.astype(dt) if dt == np.uint8 else (
+            xu.astype(np.int16) - 128).astype(np.int8)
+        q = qu.astype(dt) if dt == np.uint8 else (
+            qu.astype(np.int16) - 128).astype(np.int8)
+        dv, di = knn(jnp.asarray(x), jnp.asarray(q), K, metric="inner_product")
+        rd, ri = _bf_knn(jnp.asarray(x.astype(np.float32)),
+                         jnp.asarray(q.astype(np.float32)),
+                         K, DistanceType.InnerProduct, 2.0, 300, 300)
+        assert_knn_equiv(dv, di, rd, ri, rtol=0, atol=0)
+
+    def test_uint8_keep_mask(self, idata):
+        xu, qu = idata
+        rng = np.random.default_rng(13)
+        keep = rng.random(N) < 0.5
+        dv, di = knn(jnp.asarray(xu), jnp.asarray(qu), K,
+                     sample_filter=jnp.asarray(keep))
+        rd, ri = _bf_knn(jnp.asarray(xu.astype(np.float32)),
+                         jnp.asarray(qu.astype(np.float32)),
+                         K, DistanceType.L2Expanded, 2.0, 300, 300,
+                         jnp.asarray(keep))
+        assert_knn_equiv(dv, di, rd, ri, rtol=0, atol=0)
+
+    def test_mixed_dtype_rejected(self, idata):
+        from raft_tpu.core import RaftError
+
+        xu, qu = idata
+        with pytest.raises(RaftError, match="share a dtype"):
+            knn(jnp.asarray(xu), jnp.asarray(
+                (qu.astype(np.int16) - 128).astype(np.int8)), K)
+
+    def test_small_shape_falls_back_to_f32(self, idata):
+        """Below the kernel's shape gate the integer path casts to f32 —
+        still exact for 8-bit values."""
+        xu, qu = idata
+        dv, di = knn(jnp.asarray(xu[:1000]), jnp.asarray(qu[:20]), K)
+        rd, ri = _bf_knn(jnp.asarray(xu[:1000].astype(np.float32)),
+                         jnp.asarray(qu[:20].astype(np.float32)),
+                         K, DistanceType.L2Expanded, 2.0, 300, 300)
+        assert_knn_equiv(dv, di, rd, ri, rtol=0, atol=0)
+
+
 def test_compute_modes_recall(data):
     x, q = data
     rd, ri = _bf_knn(x, q, K, DistanceType.L2Expanded, 2.0, 300, 300)

@@ -195,6 +195,105 @@ def test_bfloat16_list_storage(rng, tmp_path):
     assert loaded.list_data.dtype == jnp.bfloat16
 
 
+class TestInt8Storage:
+    """int8/uint8 dataset support end-to-end (VERDICT r4 #2; reference:
+    ivf_flat int8_t/uint8_t instantiations,
+    cpp/src/neighbors/ivf_flat_build_uint8_t_int64_t.cu). Exhaustive probing
+    makes the search EXACT for raw 8-bit data — parity is vs the f64 ground
+    truth, not a recall threshold."""
+
+    @pytest.fixture(scope="class")
+    def idata(self):
+        rng = np.random.default_rng(3)
+        # clustered bytes: blob centers + noise, clipped to [0, 255]
+        centers = rng.integers(40, 215, (24, 32))
+        lab = rng.integers(0, 24, 3000)
+        x = np.clip(centers[lab] + rng.normal(0, 12, (3000, 32)), 0, 255)
+        qlab = rng.integers(0, 24, 50)
+        q = np.clip(centers[qlab] + rng.normal(0, 12, (50, 32)), 0, 255)
+        return x.astype(np.uint8), q.astype(np.uint8)
+
+    @pytest.mark.parametrize("dt", [np.uint8, np.int8])
+    def test_build_search_exact(self, idata, dt):
+        import jax.numpy as jnp
+
+        xu, qu = idata
+        x = xu if dt == np.uint8 else (xu.astype(np.int16) - 128).astype(np.int8)
+        q = qu if dt == np.uint8 else (qu.astype(np.int16) - 128).astype(np.int8)
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+        assert idx.list_data.dtype == jnp.int8  # auto int8 storage
+        assert idx.data_kind == dt.__name__
+        d2g, ids = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=idx.n_lists), idx, q, 10)
+        d2 = ((q[:, None, :].astype(np.float64)
+               - x[None].astype(np.float64)) ** 2).sum(-1)
+        want = np.argsort(d2, 1)[:, :10]
+        rec = _recall(np.asarray(ids), want)
+        assert rec > 0.999, rec
+        # exact integer distances
+        np.testing.assert_array_equal(
+            np.asarray(d2g), np.take_along_axis(d2, np.asarray(ids), 1))
+
+    def test_float_queries_on_uint8_index(self, idata):
+        xu, qu = idata
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), xu)
+        _, ids_int = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=16), idx, qu, 10)
+        _, ids_f = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=16), idx,
+            qu.astype(np.float32), 10)
+        np.testing.assert_array_equal(np.asarray(ids_int), np.asarray(ids_f))
+
+    def test_extend_and_serialize(self, idata, tmp_path):
+        import jax.numpy as jnp
+
+        xu, qu = idata
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0),
+                             xu[:2000])
+        idx = ivf_flat.extend(idx, xu[2000:])
+        assert idx.data_kind == "uint8" and idx.list_data.dtype == jnp.int8
+        p = str(tmp_path / "u8.bin")
+        ivf_flat.save(idx, p)
+        loaded = ivf_flat.load(p)
+        assert loaded.data_kind == "uint8"
+        d1, i1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), idx, qu, 5)
+        d2, i2 = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), loaded, qu, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_wrong_dtype_guards(self, idata):
+        from raft_tpu.core import RaftError
+
+        xu, qu = idata
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), xu)
+        with pytest.raises(RaftError, match="stores uint8"):
+            ivf_flat.extend(idx, (xu[:10].astype(np.int16) - 128).astype(np.int8))
+        with pytest.raises(RaftError, match="stores uint8"):
+            ivf_flat.search(ivf_flat.SearchParams(n_probes=4), idx,
+                            (qu.astype(np.int16) - 128).astype(np.int8), 5)
+        with pytest.raises(RaftError, match="float data is IVF-PQ"):
+            ivf_flat.build(ivf_flat.IndexParams(n_lists=16, list_dtype="int8"),
+                           xu.astype(np.float32))
+        with pytest.raises(RaftError, match="inner_product"):
+            ivf_flat.build(ivf_flat.IndexParams(
+                n_lists=16, metric="inner_product"), xu)
+
+    def test_explicit_float_storage_of_uint8(self, idata):
+        """list_dtype='float32' on uint8 input keeps the float pipeline."""
+        import jax.numpy as jnp
+
+        xu, qu = idata
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, seed=0, list_dtype="float32"), xu)
+        assert idx.data_kind == "float32"
+        assert idx.list_data.dtype == jnp.float32
+        _, ids = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=16), idx, qu.astype(np.float32), 10)
+        d2 = ((qu[:, None, :].astype(np.float64)
+               - xu[None].astype(np.float64)) ** 2).sum(-1)
+        want = np.argsort(d2, 1)[:, :10]
+        assert _recall(np.asarray(ids), want) > 0.999
+
+
 def test_oversized_list_splitting(rng):
     """A pathologically hot cluster must not inflate every list's capacity:
     it splits into sub-lists sharing the center (_list_utils.split_oversized)."""
