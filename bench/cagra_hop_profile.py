@@ -54,10 +54,9 @@ def main():
     max_iter = itopk + 10
     m = qsets[0].shape[0]
 
-    @functools.partial(jax.jit, static_argnames=("profile",))
-    def run(queries, key, profile):
+    @jax.jit
+    def init_state(queries, key, data):
         qf = queries.astype(jnp.float32)
-        data = idx.dataset
         dn2 = jnp.sum(data.astype(jnp.float32) ** 2, axis=1)
         pool_ids = jax.random.choice(key, n, (16384,), replace=False).astype(jnp.int32)
         pool_vecs = data[pool_ids].astype(jnp.float32)
@@ -72,11 +71,16 @@ def main():
         bi = jnp.full((m, 128), -1, jnp.int32
                       ).at[:, :itopk].set(jnp.take_along_axis(init_ids, order, 1))
         bv = jnp.ones((m, 128), jnp.int32).at[:, :itopk].set(0)
+        return qf, bd, bi, bv
+
+    @functools.partial(jax.jit, static_argnames=("profile",))
+    def run(state, data, graph, profile):
+        qf, bd, bi, bv = state
 
         if profile == "gatheronly":
             def body(state):
                 bd, bi, bv, pick, nocand, it = state
-                nbrs = idx.graph[pick[:, 0]]
+                nbrs = graph[pick[:, 0]]
                 vecs = data[jnp.maximum(nbrs, 0)].astype(jnp.float32)
                 # trivial consumption standing in for the kernel
                 s = jnp.sum(vecs, axis=(1, 2), keepdims=False)[:, None]
@@ -93,14 +97,15 @@ def main():
         zero_vecs = jnp.zeros((m, deg, d), jnp.float32)
         bd, bi, bv, pick, nocand = cagra_hop(
             qf, bd, bi, bv, zero_nbrs, zero_vecs,
-            jnp.zeros((m, 1), jnp.int32), itopk, deg, profile=profile)
+            jnp.zeros((m, deg), jnp.int32), itopk, width=1, profile=profile)
 
         def body(state):
             bd, bi, bv, pick, nocand, it = state
-            nbrs = idx.graph[jnp.minimum(pick[:, 0], n - 1)]
+            nbrs = graph[jnp.minimum(pick[:, 0], n - 1)]
             vecs = data[jnp.maximum(nbrs, 0)].astype(jnp.float32)
+            valid = jnp.repeat(1 - nocand, deg, axis=1)
             bd, bi, bv, pick, nocand = cagra_hop(
-                qf, bd, bi, bv, nbrs, vecs, 1 - nocand, itopk, deg,
+                qf, bd, bi, bv, nbrs, vecs, valid, itopk, width=1,
                 profile=profile)
             return bd, bi, bv, pick, nocand, it + 1
 
@@ -112,18 +117,30 @@ def main():
 
     variants = ["full", "nodedup", "nomerge", "noscore", "gatheronly"]
     key = jax.random.key(0)
+    states = [init_state(qs, key, idx.dataset) for qs in qsets]
+    jax.block_until_ready(states)
+    print("init states ready", file=sys.stderr)
+    live = []
     for v in variants:
-        jax.block_until_ready(run(qsets[0], key, v))  # compile+warm
-    times = {v: [] for v in variants}
+        try:  # compile+warm; isolate tunnel compile failures per variant
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(states[0], idx.dataset, idx.graph, v))
+            print(f"{v} compiled in {time.perf_counter()-t0:.0f}s",
+                  file=sys.stderr)
+            live.append(v)
+        except Exception as e:
+            print(f"{v} FAILED to compile/run: {str(e)[:160]}",
+                  file=sys.stderr)
+    times = {v: [] for v in live}
     for r in range(args.rounds):
-        for v in variants:
+        for v in live:
             best = float("inf")
-            for qs in qsets[1:]:
+            for st in states[1:]:
                 t0 = time.perf_counter()
-                jax.block_until_ready(run(qs, key, v))
+                jax.block_until_ready(run(st, idx.dataset, idx.graph, v))
                 best = min(best, time.perf_counter() - t0)
             times[v].append(m / best)
-    for v in variants:
+    for v in live:
         print(f"{v:11s} QPS {[f'{x/1e3:.1f}k' for x in times[v]]}")
 
 

@@ -61,10 +61,10 @@ def main() -> int:
     iters = args.iters
     rows = []
 
-    def bench(name, make_fn, batches, work, unit):
+    def bench(name, make_fn, batches, work, unit, n_iters=None):
         if args.filter and args.filter not in name:
             return
-        sec = measure(make_fn, batches, iters)
+        sec = measure(make_fn, batches, n_iters or iters)
         rate = work / sec / 1e9
         rows.append((name, sec * 1e3, rate, unit))
         print(f"{name:42s} {sec*1e3:9.2f} ms   {rate:9.1f} {unit}")
@@ -147,6 +147,103 @@ def main() -> int:
 
     bench("bf_knn 100k x 128, q=2000, k=10", mk_knn, xs,
           iters * 2.0 * 2000 * 100_000 * 128, "GFLOP/s")
+
+    # ---- sparse prims at scale (VERDICT r4 #9; ref: bench/prims/sparse/) --
+    # sparse pairwise L2: 4096-query tiles vs a 100k x 10k, ~1% density CSR
+    # dataset — exercises the ELL-densify-per-tile path at real width
+    if not args.filter or args.filter in "sparse_l2":
+        from raft_tpu.sparse.types import make_csr
+        from raft_tpu.sparse import distance as spdist
+
+        n_rows, n_cols, nnz_row = 100_000, 10_000, 100
+        qrows = 4096
+        # ~1% density: exactly nnz_row nonzeros per row (ELL-friendly,
+        # matches the reference's uniform-density sparse bench inputs)
+        idxs = rng.integers(0, n_cols, (n_rows, nnz_row)).astype(np.int32)
+        vals = rng.random((n_rows, nnz_row)).astype(np.float32)
+        indptr = np.arange(n_rows + 1, dtype=np.int32) * nnz_row
+        y_csr = make_csr(jnp.asarray(indptr), jnp.asarray(idxs.reshape(-1)),
+                         jnp.asarray(vals.reshape(-1)),
+                         (n_rows, n_cols))
+        qi = rng.integers(0, n_cols, (qrows, nnz_row)).astype(np.int32)
+        qv = [jnp.asarray(rng.random((qrows, nnz_row), np.float32))
+              for _ in range(3)]
+        q_indptr = jnp.asarray(
+            np.arange(qrows + 1, dtype=np.int32) * nnz_row)
+        qi_flat = jnp.asarray(qi.reshape(-1))
+
+        def mk_sp():
+            def one(qvals):
+                x_csr = make_csr(q_indptr, qi_flat, qvals.reshape(-1),
+                                 (qrows, n_cols))
+                return spdist.pairwise_distance(x_csr, y_csr,
+                                                metric="sqeuclidean")
+            return jax.jit(one)
+
+        # one (qrows, n_rows) distance block per call (no iters chaining);
+        # work ~ dense-equivalent GEMM
+        bench(f"sparse_l2 {qrows}x{n_rows} d={n_cols} nnz/row={nnz_row}",
+              mk_sp, qv, 2.0 * qrows * n_rows * n_cols, "GFLOP/s(dense-eq)",
+              n_iters=1)
+
+    # Boruvka MST on a 1M-edge random graph (ref: sparse/mst.cu)
+    if not args.filter or args.filter in "mst":
+        from raft_tpu.solver.mst import mst
+        from raft_tpu.sparse.types import make_coo
+
+        n_v, n_e = 200_000, 1_000_000
+        mst_batches = []
+        for s in range(3):
+            r2 = np.random.default_rng(s)
+            # connected-ish: a random spanning chain + random extra edges
+            chain_r = np.arange(n_v - 1, dtype=np.int32)
+            chain_c = chain_r + 1
+            er = r2.integers(0, n_v, n_e - (n_v - 1)).astype(np.int32)
+            ec = r2.integers(0, n_v, n_e - (n_v - 1)).astype(np.int32)
+            rr = np.concatenate([chain_r, er])
+            cc = np.concatenate([chain_c, ec])
+            ww = r2.random(n_e).astype(np.float32)
+            mst_batches.append(make_coo(jnp.asarray(rr), jnp.asarray(cc),
+                                        jnp.asarray(ww), (n_v, n_v)))
+
+        def mk_mst():
+            return jax.jit(lambda g: mst(g).weights)
+
+        # rate unit is Medges/s: pass work = edges * 1e3 so bench()'s /1e9
+        # yields Medges/s in-place
+        bench(f"mst {n_v}v {n_e}e", mk_mst, mst_batches,
+              n_e * 1e3, "Medges/s", n_iters=1)
+
+    # Lanczos k=8 on a 100k-node graph Laplacian (ref: sparse/lanczos.cu)
+    if not args.filter or args.filter in "lanczos":
+        from raft_tpu.solver.lanczos import eigsh
+        from raft_tpu.sparse.linalg import laplacian
+        from raft_tpu.sparse.types import make_coo
+        from raft_tpu.sparse.convert import coo_to_csr
+
+        n_v, n_e = 100_000, 1_000_000
+        lz_batches = []
+        for s in range(3):
+            r2 = np.random.default_rng(10 + s)
+            rr = r2.integers(0, n_v, n_e).astype(np.int32)
+            cc = r2.integers(0, n_v, n_e).astype(np.int32)
+            ww = np.abs(r2.random(n_e)).astype(np.float32)
+            # symmetrize by doubling (rows+cols swapped)
+            coo = make_coo(jnp.asarray(np.concatenate([rr, cc])),
+                           jnp.asarray(np.concatenate([cc, rr])),
+                           jnp.asarray(np.concatenate([ww, ww])),
+                           (n_v, n_v))
+            lz_batches.append(coo_to_csr(coo))
+
+        def mk_lz():
+            def one(csr):
+                lap = laplacian(csr)
+                vals, _, _ = eigsh(lap, k=8, max_iter=200, seed=0)
+                return vals
+            return jax.jit(one)
+
+        bench(f"lanczos k=8 laplacian {n_v}v", mk_lz, lz_batches,
+              2 * n_e * 200, "Gnnz-mv/s", n_iters=1)
 
     return 0
 

@@ -123,15 +123,20 @@ class SearchParams:
     #   0 → plain random entries (reference behavior).
     #   >0 → explicit pool size, honored as-is.
     seed_pool: int = -1
-    # hop-loop implementation (r05, VERDICT r4 #1):
-    #   "auto"  → the fused Pallas hop kernel (ops/cagra_hop.py) on TPU when
-    #     eligible (search_width=1, itopk+degree <= 128), else the XLA loop.
-    #     The r04 profile localized ~0.46 us/query of the search in ~20
-    #     op-at-a-time XLA passes over beam state per hop; the fused kernel
-    #     runs scoring+dedup+merge+pick as ONE launch with beam state
-    #     VMEM-resident, keeping the two gathers in XLA where the r04
-    #     head-to-head measured them fastest.
-    #   "xla" / "fused" → forced (fused validates eligibility).
+    # hop-loop implementation (r05, VERDICT r4 #1; full study in
+    # BASELINE.md "Round-5 fused hop study"):
+    #   "auto" → "fused_arena" on TPU when eligible (itopk +
+    #     search_width*degree <= 128), else the XLA loop.
+    #   "fused_arena" — ONE Pallas launch per hop (scoring + dedup + merge +
+    #     pick, beam state VMEM-resident; gathers stay in XLA per the r04
+    #     head-to-head) with a threshold-gated arena merge: candidates
+    #     insert over the arena's worst only while they beat it, so late
+    #     hops pay ~0 merge passes. Measured 1.27x the XLA loop in-process
+    #     at 1M itopk=32, identical recall.
+    #   "fused" — same kernel with the sorted extraction merge (itopk
+    #     unconditional passes); measured NEUTRAL vs XLA — kept as the
+    #     study's control.
+    #   "xla" — the op-at-a-time hop loop (reference shape).
     hop_impl: str = "auto"
     # RNG seed (int / RngState / raw key) for the seed-pool draw (ref
     # search_params :118 rand_xor_mask). Determinism contract: the same
@@ -427,12 +432,22 @@ def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
     (BASELINE.md r04 seed_pool sweep: 16384 → 0.880 on ~32k-clump data,
     65536 → 0.979). The clump scale is read off the knn graph the build just
     produced: on multi-scale data each node's sorted neighbor distances jump
-    sharply (≥4x in squared distance) at the clump boundary; the median jump
-    position is the clump size s, n/s the mode count M, and pool = ~2M
-    samples seed ≥85% of modes (1 - e^-2), which the beam's cross-clump hops
-    finish off. Isotropic/single-scale data shows no ≥4x jump and keeps the
-    default pool (a bigger pool there is a pure QPS loss — r02: -18% QPS for
-    +0.0001 recall).
+    at the clump boundary; the median jump position is the clump size s,
+    n/s the mode count M, and pool = ~2M samples seed ≥85% of modes
+    (1 - e^-2), which the beam's cross-clump hops finish off.
+
+    The ≥2.0 squared-distance ratio threshold is MEASURED (r05, true-64NN
+    profiles over 2048 sampled rows): the SIFT-class 1M set shows a median
+    max-ratio of 2.68 at a tight position (~30 = its ~31-point clumps, so
+    >50% of rows clear 2.0), while the isotropic clustered set's median is
+    1.046 with ZERO rows reaching 2.0 — within-cluster distances ramp
+    smoothly (~1.05x steps) and high-dim concentration keeps every
+    consecutive ratio near 1. An earlier ≥4.0 threshold missed the real
+    clump boundary (~2.7x: the nearest SIBLING clump sits much closer than
+    the mean offset) and shipped the 0.880-recall default on exactly the
+    data the autotune exists for. Isotropic data keeps the default pool (a
+    bigger pool there is a pure QPS loss — r02: -18% QPS for +0.0001
+    recall).
     """
     import numpy as np
 
@@ -452,12 +467,13 @@ def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
     ratios = d2[:, 1:] / d2[:, :-1]
     jump = ratios.max(axis=1)
     pos = ratios.argmax(axis=1) + 1  # in-clump neighbor count before the jump
-    clumpy = jump >= 4.0  # 2x in distance — well above gaussian concentration
+    clumpy = jump >= 2.0  # measured calibration: see docstring
     frac = float(np.mean(clumpy))
     if frac < 0.5:
         logger.info("cagra seed_pool auto: no clump structure (%.0f%% of "
-                    "sampled rows show a >=4x neighbor-distance jump) — "
-                    "default pool", frac * 100)
+                    "sampled rows show a >=2x neighbor-distance jump; "
+                    "median max-ratio %.2f) — default pool", frac * 100,
+                    float(np.median(jump)))
         return 0
     s = float(np.median(pos[clumpy])) + 1.0  # + self
     modes = n / s
@@ -563,7 +579,7 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
 
     beam_ids, beam_d, beam_visited = dedup_sort(beam_ids, beam_d, beam_visited)
 
-    if hop_impl == "fused":
+    if hop_impl in ("fused", "fused_arena"):
         # one Pallas launch per hop: scoring+dedup+merge+pick with beam state
         # VMEM-resident (VERDICT r4 #1; ops/cagra_hop.py docstring has the
         # profile-driven rationale). Beam distances carry the FULL ||v-q||^2
@@ -571,6 +587,7 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         from ..ops.cagra_hop import cagra_hop, hop_backend_ok
 
         _, interpret = hop_backend_ok()
+        merge = "arena" if hop_impl == "fused_arena" else "extract"
         qn = jnp.sum(qf * qf, axis=1, keepdims=True)
         P = 128
         bd = jnp.full((m, P), jnp.inf, jnp.float32
@@ -581,30 +598,41 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         bv = jnp.ones((m, P), jnp.int32).at[:, :itopk].set(
             beam_visited[:, :itopk].astype(jnp.int32))
         # prime: candidates masked (valid=0) — merge is a no-op re-sort, and
-        # the kernel emits the first hop's pick
-        zero_nbrs = jnp.full((m, deg), -1, jnp.int32)
-        zero_vecs = jnp.zeros((m, deg, d), jnp.float32)
+        # the kernel emits the first hop's picks
+        cw = width * deg
+        zero_nbrs = jnp.full((m, cw), -1, jnp.int32)
+        zero_vecs = jnp.zeros((m, cw, d), jnp.float32)
         bd, bi, bv, pick, nocand = cagra_hop(
             qf, bd, bi, bv, zero_nbrs, zero_vecs,
-            jnp.zeros((m, 1), jnp.int32), itopk, deg, interpret=interpret)
+            jnp.zeros((m, cw), jnp.int32), itopk, width,
+            interpret=interpret, merge=merge)
 
         def fcond(state):
             _, _, _, _, nocand, it = state
+            # a query is done when its FIRST pick found nothing unvisited
+            # (picks are best-first, so later picks can only also fail)
             return jnp.logical_and(it < max_iter,
-                                   jnp.logical_not(jnp.all(nocand > 0)))
+                                   jnp.logical_not(jnp.all(nocand[:, 0] > 0)))
 
         def fbody(state):
             bd, bi, bv, pick, nocand, it = state
-            safe = jnp.minimum(pick[:, 0], n - 1)
-            nbrs = index.graph[safe]                     # (m, deg)
+            safe = jnp.minimum(pick, n - 1)              # (m, width)
+            nbrs = index.graph[safe].reshape(m, cw)      # (m, width*deg)
             vecs = data[jnp.maximum(nbrs, 0)].astype(jnp.float32)
+            valid = jnp.repeat(1 - nocand, deg, axis=1)  # per-candidate
             bd, bi, bv, pick, nocand = cagra_hop(
-                qf, bd, bi, bv, nbrs, vecs, 1 - nocand, itopk, deg,
-                interpret=interpret)
+                qf, bd, bi, bv, nbrs, vecs, valid, itopk, width,
+                interpret=interpret, merge=merge)
             return bd, bi, bv, pick, nocand, it + 1
 
         bd, bi, bv, _, _, _ = lax.while_loop(
             fcond, fbody, (bd, bi, bv, pick, nocand, 0))
+        if merge == "arena":
+            # arena beam is unsorted — one final sort (the XLA path pays a
+            # sort per hop; arena pays it once here)
+            from ..matrix.select_k import _select_k
+
+            bd, bi = _select_k(bd, bi, itopk, True)
         out_d = jnp.maximum(bd[:, :k], 0.0)
         if sqrt_out:
             out_d = jnp.sqrt(out_d)
@@ -673,16 +701,20 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int) -> str:
     distributed searches — same eligibility rules, same clear errors)."""
     from ..ops.cagra_hop import hop_backend_ok, hop_shapes_eligible
 
-    expects(params.hop_impl in ("auto", "xla", "fused"),
-            "hop_impl must be 'auto', 'xla' or 'fused', got %r",
-            params.hop_impl)
+    expects(params.hop_impl in ("auto", "xla", "fused", "fused_arena"),
+            "hop_impl must be 'auto', 'xla', 'fused' or 'fused_arena', "
+            "got %r", params.hop_impl)
     eligible = (hop_backend_ok()[0] and hop_shapes_eligible(
         params.itopk_size, graph_degree, params.search_width, dim))
     if params.hop_impl == "auto":
-        return "fused" if eligible else "xla"
-    if params.hop_impl == "fused":
-        expects(eligible, "hop_impl='fused' needs search_width=1, "
-                "itopk+graph_degree <= 128 and a TPU backend (or "
+        # fused_arena is the measured winner (r05 study, BASELINE.md):
+        # 41-42k vs 32-33k XLA QPS at 1M itopk=32, identical 0.9714 recall
+        # (1.27x in-process); plain "fused" (sorted extraction merge)
+        # measured NEUTRAL and stays as the study's control
+        return "fused_arena" if eligible else "xla"
+    if params.hop_impl in ("fused", "fused_arena"):
+        expects(eligible, "hop_impl='fused' needs itopk + "
+                "search_width*graph_degree <= 128 and a TPU backend (or "
                 "RAFT_TPU_CAGRA_HOP_INTERPRET=1 for tests)")
     return params.hop_impl
 

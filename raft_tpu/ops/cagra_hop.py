@@ -42,6 +42,7 @@ __all__ = ["cagra_hop", "hop_backend_ok", "hop_shapes_eligible"]
 _POOL = 128               # merge pool lanes: itopk + deg must fit
 _BIG = 2 ** 30
 _INF = jnp.inf
+_NEG = -3.0e38            # finite sentinel for masked maxima
 
 
 def hop_backend_ok():
@@ -56,33 +57,43 @@ def hop_backend_ok():
 
 
 def hop_shapes_eligible(itopk: int, deg: int, width: int, d: int) -> bool:
-    """The fused hop supports the single-pick beam (search_width=1 — the
-    default and the only width the r04 profile measured) with the merge pool
-    inside one 128-lane register row."""
-    return width == 1 and itopk + deg <= _POOL and itopk >= 1 and d <= 4096
+    """The fused hop supports any search_width whose merge pool
+    (itopk + width*degree candidates) fits one 128-lane register row."""
+    return (width >= 1 and itopk + width * deg <= _POOL and itopk >= 1
+            and d <= 4096)
 
 
-def _make_hop_kernel(itopk: int, deg: int, qt: int, dp: int,
-                     profile: str = "full"):
+def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
+                     profile: str = "full", merge: str = "extract"):
     """``profile`` carves phases out for the in-kernel profile
     (bench/cagra_hop_profile.py): "full", "noscore" (skip the distance
     computation), "nodedup" (skip the beam-membership masks), "nomerge"
-    (skip dedup+extraction — beam passes through, pick still computed)."""
+    (skip dedup+extraction — beam passes through, pick still computed).
+    ``merge``: "extract" (itopk ascending-extraction passes; beam stays
+    sorted) or "arena" (threshold-gated insertion into an unsorted arena —
+    the caller sorts once after the loop)."""
     def kernel(q_ref, bd_ref, bi_ref, bv_ref, nbr_ref, vec_ref, valid_ref,
                nbd_ref, nbi_ref, nbv_ref, pick_ref, nocand_ref,
-               pd_ref, pi_ref, pv_ref):
+               pd_ref, pi_ref, pv_ref, go_ref):
         lane = jax.lax.broadcasted_iota(jnp.int32, (qt, _POOL), 1)
 
-        # ---- candidate scoring: ||v - q||^2, (qt, deg) ----
-        nbr = nbr_ref[...]                   # (qt, deg) int32
+        # ---- candidate scoring: direct ||v - q||^2, (qt, cw). The
+        # expanded ||v||^2 - 2 q.v form was tried and measured WORSE (r05):
+        # gathering ||v||^2 per candidate doubles the hop's random-gather
+        # count (4 B norm gathers are as latency-bound as the 512 B rows)
+        # and costs far more than the one VPU pass it saves (arena
+        # 38k -> 28.5k QPS at 1M).
+        nbr = nbr_ref[...]                   # (qt, cw) int32
         if profile == "noscore":
             nd = jnp.abs(nbr).astype(jnp.float32)  # fake but well-formed
         else:
             q = q_ref[...]                   # (qt, dp)
-            vecs = vec_ref[...]              # (qt, deg, dp)
+            vecs = vec_ref[...]              # (qt, cw, dp)
             diff = vecs - q[:, None, :]
-            nd = jnp.sum(diff * diff, axis=-1)   # (qt, deg)
-        ok = (nbr >= 0) & (valid_ref[...] > 0)          # (qt, deg) & (qt, 1)
+            nd = jnp.sum(diff * diff, axis=-1)   # (qt, cw)
+        # valid is per-candidate (the XLA side expands the per-pick flags
+        # over each pick's deg candidates)
+        ok = (nbr >= 0) & (valid_ref[...] > 0)          # (qt, cw)
         nd = jnp.where(ok, nd, _INF)
 
         # ---- dedup vs the beam: a candidate already in the beam carries
@@ -92,87 +103,146 @@ def _make_hop_kernel(itopk: int, deg: int, qt: int, dp: int,
             nbd_ref[...] = bd_ref[...]
             nbi_ref[...] = bi
             nbv_ref[...] = bv_ref[...]
-            _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref,
+            _emit_pick(itopk, width, qt, lane, nbd_ref, nbi_ref, nbv_ref,
                        pick_ref, nocand_ref)
             return
-        if profile != "nodedup":
+        if profile != "nodedup" and not (merge == "arena"
+                                         and profile == "full"):
             for b in range(itopk):
                 nd = jnp.where(nbr == bi[:, b:b + 1], _INF, nd)
 
-        # ---- merge pool: [beam | candidates | +inf pad], one row ----
-        pd_ref[...] = bd_ref[...]
-        pi_ref[...] = bi
-        pv_ref[...] = bv_ref[...]
-        pd_ref[:, itopk:itopk + deg] = nd
-        pi_ref[:, itopk:itopk + deg] = nbr
-        pv_ref[:, itopk:itopk + deg] = jnp.zeros((qt, deg), jnp.int32)
-        pd_ref[:, itopk + deg:] = jnp.full((qt, _POOL - itopk - deg), _INF,
-                                           jnp.float32)
-        pi_ref[:, itopk + deg:] = jnp.full((qt, _POOL - itopk - deg), -1,
-                                           jnp.int32)
-        pv_ref[:, itopk + deg:] = jnp.ones((qt, _POOL - itopk - deg),
-                                           jnp.int32)
+        if merge == "arena" and profile == "full":
+            # ---- threshold-gated arena merge: the beam is an UNSORTED
+            # arena of itopk slots (sorted once in XLA after the loop); a
+            # candidate is inserted — replacing the arena's current worst —
+            # only while the best remaining candidate beats that worst.
+            # Late hops insert ~0-2 candidates, so the whole merge gates
+            # off after a couple of iterations (the fused_knn per-tile-gate
+            # insight applied to the beam), vs itopk unconditional
+            # extraction passes. Candidate count bounds the iterations.
+            nbd_ref[...] = bd_ref[...]
+            nbi_ref[...] = bi
+            nbv_ref[...] = bv_ref[...]
+            # stash candidate scores in the pool scratch (ids in pi)
+            pd_ref[:, :cw] = nd
+            pi_ref[:, :cw] = nbr
+            go_ref[0] = 1
+            for t in range(cw):
+                @pl.when(go_ref[0] == 1)
+                def _insert(t=t):
+                    ad = nbd_ref[...]
+                    admask = jnp.where(lane < itopk, ad, _NEG)
+                    worst = jnp.max(admask, axis=1, keepdims=True)
+                    cd = pd_ref[:, :cw]
+                    best = jnp.min(cd, axis=1, keepdims=True)
+                    improve = best < worst              # (qt, 1)
+                    go_ref[0] = jnp.any(improve).astype(jnp.int32)
 
-        # ---- ascending extraction with lowest-id ties: the in-VMEM form of
-        # the XLA path's lexsort+sort dedup merge ----
-        nbd_ref[...] = jnp.full((qt, _POOL), _INF, jnp.float32)
-        nbi_ref[...] = jnp.full((qt, _POOL), -1, jnp.int32)
-        nbv_ref[...] = jnp.ones((qt, _POOL), jnp.int32)
-        for t in range(itopk):
-            pdv = pd_ref[...]
-            mn = jnp.min(pdv, axis=1, keepdims=True)
-            sel = pdv <= mn                          # winners incl. ties
-            amid = jnp.min(jnp.where(sel, pi_ref[...], _BIG), axis=1,
-                           keepdims=True)
-            hit = (pi_ref[...] == amid) & sel
-            wv = jnp.min(jnp.where(hit, pv_ref[...], _BIG), axis=1,
-                         keepdims=True)
-            nbd_ref[:, t] = mn[:, 0]
-            nbi_ref[:, t] = jnp.where(mn[:, 0] < _INF, amid[:, 0], -1)
-            nbv_ref[:, t] = jnp.minimum(wv[:, 0], 1)
-            # mask every copy of the chosen id (kills in-row duplicates too)
-            pd_ref[...] = jnp.where(pi_ref[...] == amid, _INF, pdv)
+                    @pl.when(jnp.any(improve))
+                    def _apply():
+                        cdv = pd_ref[:, :cw]
+                        civ = pi_ref[:, :cw]
+                        bid = jnp.min(jnp.where(cdv <= best, civ, _BIG),
+                                      axis=1, keepdims=True)
+                        # dedup HERE instead of a 32-pass pre-mask: a
+                        # candidate already in the arena carries the same
+                        # exact score there — consume it without inserting
+                        ai = nbi_ref[...]
+                        dup = jnp.any((ai == bid) & (lane < itopk), axis=1,
+                                      keepdims=True)
+                        ins = improve & jnp.logical_not(dup)
+                        # arena slot to evict: the worst entry, highest
+                        # lane on ties (any one copy)
+                        wsel = (admask >= worst)
+                        wlane = jnp.max(jnp.where(wsel, lane, -1), axis=1,
+                                        keepdims=True)
+                        at = ins & (lane == wlane)
+                        nbd_ref[...] = jnp.where(at, best, ad)
+                        nbi_ref[...] = jnp.where(at, bid, ai)
+                        nbv_ref[...] = jnp.where(at, 0, nbv_ref[...])
+                        # consume the candidate (all copies of its id)
+                        pd_ref[:, :cw] = jnp.where(
+                            improve & (civ == bid), _INF, cdv)
+        else:
+            # ---- merge pool: [beam | candidates | +inf pad], one row ----
+            pd_ref[...] = bd_ref[...]
+            pi_ref[...] = bi
+            pv_ref[...] = bv_ref[...]
+            pd_ref[:, itopk:itopk + cw] = nd
+            pi_ref[:, itopk:itopk + cw] = nbr
+            pv_ref[:, itopk:itopk + cw] = jnp.zeros((qt, cw), jnp.int32)
+            pd_ref[:, itopk + cw:] = jnp.full((qt, _POOL - itopk - cw), _INF,
+                                              jnp.float32)
+            pi_ref[:, itopk + cw:] = jnp.full((qt, _POOL - itopk - cw), -1,
+                                              jnp.int32)
+            pv_ref[:, itopk + cw:] = jnp.ones((qt, _POOL - itopk - cw),
+                                              jnp.int32)
+            # ---- ascending extraction with lowest-id ties: the in-VMEM
+            # form of the XLA path's lexsort+sort dedup merge ----
+            nbd_ref[...] = jnp.full((qt, _POOL), _INF, jnp.float32)
+            nbi_ref[...] = jnp.full((qt, _POOL), -1, jnp.int32)
+            nbv_ref[...] = jnp.ones((qt, _POOL), jnp.int32)
+            for t in range(itopk):
+                pdv = pd_ref[...]
+                mn = jnp.min(pdv, axis=1, keepdims=True)
+                sel = pdv <= mn                          # winners incl. ties
+                amid = jnp.min(jnp.where(sel, pi_ref[...], _BIG), axis=1,
+                               keepdims=True)
+                hit = (pi_ref[...] == amid) & sel
+                wv = jnp.min(jnp.where(hit, pv_ref[...], _BIG), axis=1,
+                             keepdims=True)
+                nbd_ref[:, t] = mn[:, 0]
+                nbi_ref[:, t] = jnp.where(mn[:, 0] < _INF, amid[:, 0], -1)
+                nbv_ref[:, t] = jnp.minimum(wv[:, 0], 1)
+                # mask every copy of the chosen id (kills in-row dups too)
+                pd_ref[...] = jnp.where(pi_ref[...] == amid, _INF, pdv)
 
-        _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref,
+        _emit_pick(itopk, width, qt, lane, nbd_ref, nbi_ref, nbv_ref,
                    pick_ref, nocand_ref)
 
     return kernel
 
 
-def _emit_pick(itopk, qt, lane, nbd_ref, nbi_ref, nbv_ref, pick_ref,
+def _emit_pick(itopk, width, qt, lane, nbd_ref, nbi_ref, nbv_ref, pick_ref,
                nocand_ref):
-    """Next pick: best unvisited in the itopk window; mark it visited."""
+    """Next picks: the ``width`` best unvisited entries in the itopk window,
+    each marked visited as it is taken (matching the XLA loop's argsort
+    top-width pick)."""
     nbd = nbd_ref[...]
-    nbv = nbv_ref[...]
-    cd = jnp.where((nbv > 0) | (lane >= itopk), _INF, nbd)
-    mn = jnp.min(cd, axis=1, keepdims=True)
-    nocand = (mn >= _INF).astype(jnp.int32)
-    sel = cd <= mn
-    pick_id = jnp.min(jnp.where(sel, nbi_ref[...], _BIG), axis=1,
-                      keepdims=True)
-    nbv_ref[...] = jnp.where(
-        (nbi_ref[...] == pick_id) & (nocand == 0), 1, nbv)
-    pick_ref[...] = jnp.clip(pick_id, 0, _BIG)
-    nocand_ref[...] = nocand
+    for w in range(width):
+        nbv = nbv_ref[...]
+        cd = jnp.where((nbv > 0) | (lane >= itopk), _INF, nbd)
+        mn = jnp.min(cd, axis=1, keepdims=True)
+        nocand = (mn >= _INF).astype(jnp.int32)
+        sel = cd <= mn
+        pick_id = jnp.min(jnp.where(sel, nbi_ref[...], _BIG), axis=1,
+                          keepdims=True)
+        nbv_ref[...] = jnp.where(
+            (nbi_ref[...] == pick_id) & (nocand == 0), 1, nbv)
+        pick_ref[:, w] = jnp.clip(pick_id[:, 0], 0, _BIG)
+        nocand_ref[:, w] = nocand[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("itopk", "deg", "qt", "interpret",
-                                             "profile"))
+@functools.partial(jax.jit, static_argnames=("itopk", "width", "qt",
+                                             "interpret", "profile", "merge"))
 def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
-              itopk: int, deg: int, qt: int = 128, interpret: bool = False,
-              profile: str = "full"):
+              itopk: int, width: int = 1, qt: int = 128,
+              interpret: bool = False, profile: str = "full",
+              merge: str = "extract"):
     """One fused CAGRA hop over the whole query batch.
 
     ``queries`` (m, d) f32; ``beam_d/beam_i/beam_v`` (m, 128) padded beam
     state (distances f32 ascending, ids i32, visited i32; lanes >= itopk are
-    +inf/-1/1); ``nbrs`` (m, deg) i32 candidate ids (-1 = none); ``vecs``
-    (m, deg, d) their vectors; ``valid`` (m, 1) i32 — 0 masks this hop's
-    candidates (used to prime the loop and after convergence).
+    +inf/-1/1); ``nbrs`` (m, cw) i32 candidate ids for cw = width*degree
+    (-1 = none); ``vecs`` (m, cw, d) their vectors; ``valid`` (m, cw) i32 —
+    0 masks a candidate (the caller expands each pick's validity over its
+    deg candidates; all-zero primes the loop).
 
-    Returns (beam_d, beam_i, beam_v, pick (m, 1) i32 clipped >= 0,
-    no_cand (m, 1) i32).
+    Returns (beam_d, beam_i, beam_v, pick (m, width) i32 clipped >= 0,
+    no_cand (m, width) i32). Beam distances are full ||v - q||^2.
     """
     m, d = queries.shape
+    cw = nbrs.shape[1]
     dp = -(-d // 128) * 128
     mp = -(-m // qt) * qt
     pad_rows = mp - m
@@ -190,26 +260,27 @@ def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
     spec2 = lambda w: pl.BlockSpec((qt, w), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM)
     outs = pl.pallas_call(
-        _make_hop_kernel(itopk, deg, qt, dp, profile),
+        _make_hop_kernel(itopk, cw, width, qt, dp, profile, merge),
         grid=grid,
         in_specs=[spec2(dp), spec2(_POOL), spec2(_POOL), spec2(_POOL),
-                  spec2(deg),
-                  pl.BlockSpec((qt, deg, dp), lambda i: (i, 0, 0),
+                  spec2(cw),
+                  pl.BlockSpec((qt, cw, dp), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-                  spec2(1)],
-        out_specs=[spec2(_POOL), spec2(_POOL), spec2(_POOL), spec2(1),
-                   spec2(1)],
+                  spec2(cw)],
+        out_specs=[spec2(_POOL), spec2(_POOL), spec2(_POOL), spec2(width),
+                   spec2(width)],
         out_shape=[
             jax.ShapeDtypeStruct((mp, _POOL), jnp.float32),
             jax.ShapeDtypeStruct((mp, _POOL), jnp.int32),
             jax.ShapeDtypeStruct((mp, _POOL), jnp.int32),
-            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
-            jax.ShapeDtypeStruct((mp, 1), jnp.int32),
+            jax.ShapeDtypeStruct((mp, width), jnp.int32),
+            jax.ShapeDtypeStruct((mp, width), jnp.int32),
         ],
         scratch_shapes=[
             pltpu.VMEM((qt, _POOL), jnp.float32),   # merge pool distances
             pltpu.VMEM((qt, _POOL), jnp.int32),     # merge pool ids
             pltpu.VMEM((qt, _POOL), jnp.int32),     # merge pool visited
+            pltpu.SMEM((1,), jnp.int32),            # arena insertion gate
         ],
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),

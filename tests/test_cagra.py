@@ -147,13 +147,14 @@ class TestFusedHop:
     lowest-id ties, visited tracking), so same neighbor sets and distances
     up to summation order."""
 
-    def test_matches_xla_loop(self, index, data, monkeypatch):
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    def test_matches_xla_loop(self, index, data, monkeypatch, impl):
         monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
         x, q = data
         d_x, i_x = cagra.search(
             cagra.SearchParams(itopk_size=32, hop_impl="xla"), index, q, k=10)
         d_f, i_f = cagra.search(
-            cagra.SearchParams(itopk_size=32, hop_impl="fused"), index, q, k=10)
+            cagra.SearchParams(itopk_size=32, hop_impl=impl), index, q, k=10)
         i_x, i_f = np.asarray(i_x), np.asarray(i_f)
         # id sets match except where summation-order ULP noise reorders
         # near-ties at the beam boundary
@@ -164,7 +165,8 @@ class TestFusedHop:
                                    np.sort(np.asarray(d_x), 1),
                                    rtol=1e-4, atol=1e-4)
 
-    def test_recall_on_clustered(self, monkeypatch):
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    def test_recall_on_clustered(self, monkeypatch, impl):
         monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
         x, _ = make_blobs(3000, 24, n_clusters=30, cluster_std=0.5, seed=2)
         x = np.asarray(x)
@@ -173,7 +175,7 @@ class TestFusedHop:
         q = x[:150]
         true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
         _, ids = cagra.search(cagra.SearchParams(
-            itopk_size=32, hop_impl="fused"), idx, q, k=10)
+            itopk_size=32, hop_impl=impl), idx, q, k=10)
         rec = _recall(np.asarray(ids), true_i)
         assert rec > 0.9, rec
 
@@ -190,18 +192,37 @@ class TestFusedHop:
         assert idx.metric in (DistanceType.L2SqrtExpanded,
                               DistanceType.L2SqrtUnexpanded)
         d_f, i_f = cagra.search(cagra.SearchParams(
-            itopk_size=32, hop_impl="fused"), idx, q, k=5)
+            itopk_size=32, hop_impl="fused_arena"), idx, q, k=5)
         d_true = np.sqrt(((q[:, None, :] - x[np.asarray(i_f)]) ** 2).sum(-1))
         np.testing.assert_allclose(np.asarray(d_f), d_true, rtol=1e-4,
                                    atol=1e-4)
+
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    def test_matches_xla_loop_width2(self, index, data, monkeypatch, impl):
+        """search_width=2: two picks per hop, candidate block 2*deg — must
+        still track the XLA loop."""
+        monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
+        _, q = data
+        d_x, i_x = cagra.search(cagra.SearchParams(
+            itopk_size=32, search_width=2, hop_impl="xla"), index, q, k=10)
+        d_f, i_f = cagra.search(cagra.SearchParams(
+            itopk_size=32, search_width=2, hop_impl=impl), index, q, k=10)
+        i_x, i_f = np.asarray(i_x), np.asarray(i_f)
+        overlap = np.mean([len(set(i_x[r]) & set(i_f[r])) / 10
+                           for r in range(i_x.shape[0])])
+        assert overlap > 0.95, overlap
+        np.testing.assert_allclose(np.sort(np.asarray(d_f), 1),
+                                   np.sort(np.asarray(d_x), 1),
+                                   rtol=1e-4, atol=1e-4)
 
     def test_eligibility_guard(self, index, data):
         from raft_tpu.core import RaftError
 
         _, q = data
+        # itopk 64 + 3*24 = 136 > 128: pool does not fit one register row
         with pytest.raises(RaftError, match="hop_impl='fused'"):
             cagra.search(cagra.SearchParams(
-                itopk_size=32, search_width=2, hop_impl="fused"),
+                itopk_size=64, search_width=3, hop_impl="fused"),
                 index, q, k=5)
 
 
@@ -236,7 +257,7 @@ class TestSeedPoolAuto:
         assert pool == 32768, pool
 
     def test_isotropic_keeps_default(self):
-        """Uniform data + random graph: no >=4x jump — hint 0 (default pool;
+        """Uniform data + random graph: no >=2x jump — hint 0 (default pool;
         a bigger pool on isotropic data is a pure QPS loss, r02)."""
         rng = np.random.default_rng(1)
         n, d = 8192, 16
