@@ -77,6 +77,48 @@ def load_dataset(spec: dict):
                 return np.clip(np.rint(x), 0, 255).astype(np.uint8)
 
             return draw_u8(syn["n"]), draw_u8(syn["n_queries"]), metric
+        if syn.get("family") == "heavytail":
+            # second independent realistic family (VERDICT r4 #10),
+            # deliberately breaking the siftclass generator's symmetries:
+            # - cluster POPULATIONS are Zipf-distributed (a few huge
+            #   clusters, a long tail of tiny ones) — stresses list
+            #   splitting and probe allocation;
+            # - per-cluster intrinsic dims VARY (4..32) and the subspaces
+            #   are CORRELATED across clusters (each cluster draws its
+            #   basis rows from one shared 64-direction pool, the way real
+            #   descriptor manifolds share global structure);
+            # - residual scales are LOGNORMAL per cluster — local density
+            #   varies by orders of magnitude, unlike one fine_std.
+            dim = syn["dim"]
+            ncl = syn.get("clusters", 2000)
+            zipf = syn.get("zipf", 1.0)
+            w = (1.0 / np.arange(1, ncl + 1)) ** zipf
+            w /= w.sum()
+            centers = rng.random((ncl, dim)).astype(np.float32) * 10
+            pool = rng.normal(size=(64, dim)).astype(np.float32)
+            pool /= np.linalg.norm(pool, axis=1, keepdims=True)
+            max_id = 32
+            idims = rng.integers(4, max_id + 1, ncl)
+            basis_rows = np.stack([rng.choice(64, max_id, replace=False)
+                                   for _ in range(ncl)])
+            bases = pool[basis_rows]                       # (ncl, 32, dim)
+            mask = (np.arange(max_id)[None, :]
+                    < idims[:, None]).astype(np.float32)   # (ncl, 32)
+            scales = rng.lognormal(mean=np.log(0.25), sigma=0.8,
+                                   size=ncl).astype(np.float32)
+
+            def draw_ht(count):
+                parts = []
+                for s in range(0, count, 50_000):
+                    c = min(50_000, count - s)
+                    labels = rng.choice(ncl, c, p=w)
+                    z = (rng.normal(size=(c, max_id)).astype(np.float32)
+                         * mask[labels] * scales[labels][:, None])
+                    parts.append((centers[labels] + np.einsum(
+                        "ni,nid->nd", z, bases[labels])).astype(np.float32))
+                return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+            return draw_ht(syn["n"]), draw_ht(syn["n_queries"]), metric
         if n_clusters:
             dim = syn["dim"]
             centers = rng.random((n_clusters, dim), np.float32) * 10

@@ -1,0 +1,90 @@
+"""Parallel-driver overhead bound (VERDICT r4 #8): on ONE real chip, a
+1-device-mesh A/B of the distributed drivers vs their single-chip twins —
+the shard_map + allgather + merge cost with zero actual communication, the
+only multi-chip perf evidence obtainable on one chip.
+
+Run on the TPU host:  python bench/parallel_overhead_ab.py [--rounds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=3)
+    args = ap.parse_args()
+
+    from raft_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import bench as drv
+    from raft_tpu import parallel
+    from raft_tpu.comms import Comms
+    from raft_tpu.neighbors import brute_force, ivf_flat
+
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    comms = Comms(mesh, "data")
+
+    dataset, qsets = drv._make_1m()
+    jax.block_until_ready([dataset] + qsets)
+    m = qsets[0].shape[0]
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024, seed=0), dataset)
+    jax.block_until_ready(idx.list_data)
+    print("build done", file=sys.stderr)
+
+    # the distributed IVF search pads n_lists to a mesh multiple — on a
+    # 1-device mesh that's a no-op, isolating pure driver overhead
+    variants = {
+        "bf_single": lambda q: brute_force.knn(dataset, q, 10),
+        "bf_parallel": lambda q: parallel.knn.knn(comms, dataset, q, k=10),
+        "ivf_single": lambda q: ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=8), idx, q, 10),
+        "ivf_parallel": lambda q: parallel.ivf.search(
+            comms, ivf_flat.SearchParams(n_probes=8), idx, q, 10),
+    }
+    outs = {}
+    for name, fn in variants.items():
+        t0 = time.perf_counter()
+        outs[name] = fn(qsets[0])
+        np.asarray(outs[name][0])
+        print(f"{name} compiled {time.perf_counter()-t0:.0f}s",
+              file=sys.stderr)
+    times = {n: [] for n in variants}
+    for r in range(args.rounds):
+        for name, fn in variants.items():
+            best = float("inf")
+            for qs in qsets[1:]:
+                t0 = time.perf_counter()
+                out = fn(qs)
+                np.asarray(out[0])
+                best = min(best, time.perf_counter() - t0)
+            times[name].append(m / best)
+    for name in variants:
+        print(f"{name:13s} QPS {[f'{v/1e3:.1f}k' for v in times[name]]}")
+    for pair in (("bf_parallel", "bf_single"), ("ivf_parallel", "ivf_single")):
+        ratio = max(times[pair[0]]) / max(times[pair[1]])
+        print(f"{pair[0]}/{pair[1]}: {ratio:.3f}")
+    # sanity: same neighbor sets
+    for a, b in (("bf_single", "bf_parallel"), ("ivf_single", "ivf_parallel")):
+        ia, ib = np.asarray(outs[a][1])[:500], np.asarray(outs[b][1])[:500]
+        ov = np.mean([len(set(ia[r]) & set(ib[r])) / ia.shape[1]
+                      for r in range(500)])
+        print(f"overlap {a} vs {b}: {ov:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -151,7 +151,8 @@ def main() -> int:
     # ---- sparse prims at scale (VERDICT r4 #9; ref: bench/prims/sparse/) --
     # sparse pairwise L2: 4096-query tiles vs a 100k x 10k, ~1% density CSR
     # dataset — exercises the ELL-densify-per-tile path at real width
-    if not args.filter or args.filter in "sparse_l2":
+    sp_name = "sparse_l2 4096x100000 d=10000 nnz/row=100"
+    if not args.filter or args.filter in sp_name:
         from raft_tpu.sparse.types import make_csr
         from raft_tpu.sparse import distance as spdist
 
@@ -173,21 +174,24 @@ def main() -> int:
         qi_flat = jnp.asarray(qi.reshape(-1))
 
         def mk_sp():
+            # NOT jitted: pairwise_distance is host-orchestrated (it sizes
+            # the ELL width from data-dependent degrees) and jits its tiles
+            # internally — wrapping it would trip a ConcretizationTypeError
             def one(qvals):
                 x_csr = make_csr(q_indptr, qi_flat, qvals.reshape(-1),
                                  (qrows, n_cols))
                 return spdist.pairwise_distance(x_csr, y_csr,
                                                 metric="sqeuclidean")
-            return jax.jit(one)
+            return one
 
         # one (qrows, n_rows) distance block per call (no iters chaining);
         # work ~ dense-equivalent GEMM
-        bench(f"sparse_l2 {qrows}x{n_rows} d={n_cols} nnz/row={nnz_row}",
-              mk_sp, qv, 2.0 * qrows * n_rows * n_cols, "GFLOP/s(dense-eq)",
-              n_iters=1)
+        bench(sp_name, mk_sp, qv, 2.0 * qrows * n_rows * n_cols,
+              "GFLOP/s(dense-eq)", n_iters=1)
 
     # Boruvka MST on a 1M-edge random graph (ref: sparse/mst.cu)
-    if not args.filter or args.filter in "mst":
+    mst_name = "mst 200000v 1000000e"
+    if not args.filter or args.filter in mst_name:
         from raft_tpu.solver.mst import mst
         from raft_tpu.sparse.types import make_coo
 
@@ -211,11 +215,12 @@ def main() -> int:
 
         # rate unit is Medges/s: pass work = edges * 1e3 so bench()'s /1e9
         # yields Medges/s in-place
-        bench(f"mst {n_v}v {n_e}e", mk_mst, mst_batches,
-              n_e * 1e3, "Medges/s", n_iters=1)
+        bench(mst_name, mk_mst, mst_batches, n_e * 1e3, "Medges/s",
+              n_iters=1)
 
     # Lanczos k=8 on a 100k-node graph Laplacian (ref: sparse/lanczos.cu)
-    if not args.filter or args.filter in "lanczos":
+    lz_name = "lanczos k=8 laplacian 100000v"
+    if not args.filter or args.filter in lz_name:
         from raft_tpu.solver.lanczos import eigsh
         from raft_tpu.sparse.linalg import laplacian
         from raft_tpu.sparse.types import make_coo
@@ -242,8 +247,8 @@ def main() -> int:
                 return vals
             return jax.jit(one)
 
-        bench(f"lanczos k=8 laplacian {n_v}v", mk_lz, lz_batches,
-              2 * n_e * 200, "Gnnz-mv/s", n_iters=1)
+        bench(lz_name, mk_lz, lz_batches, 2 * n_e * 200, "Gnnz-mv/s",
+              n_iters=1)
 
     return 0
 

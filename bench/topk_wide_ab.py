@@ -43,15 +43,17 @@ def main():
 
     print(f"backend: {jax.default_backend()}", file=sys.stderr)
     m, n, chain = args.rows, args.cols, args.chain
-    keys = jax.random.split(jax.random.key(0), chain)
+    nmats = max(chain, 4)
+    keys = jax.random.split(jax.random.key(0), nmats)
     mats = [jax.random.uniform(k, (m, n), jnp.float32) for k in keys]
     jax.block_until_ready(mats)
-    bytes_gb = m * n * 4 * chain / 1e9
+    bytes_gb = m * n * 4 * max(chain, 1) / 1e9
 
     for k in (int(s) for s in args.ks.split(",")):
 
         @functools.partial(jax.jit, static_argnames=())
         def chain_pallas(ms, k=k):
+            ms = ms[:chain]
             acc = jnp.zeros((), jnp.float32)
             for x in ms:
                 v, i = topk_pallas(x, k, select_min=True)
@@ -60,11 +62,34 @@ def main():
 
         @functools.partial(jax.jit, static_argnames=())
         def chain_lax(ms, k=k):
+            ms = ms[:chain]
             acc = jnp.zeros((), jnp.float32)
             for x in ms:
                 nv, ni = lax.top_k(-x, k)
                 acc = acc + (-nv)[:, k - 1].sum() + (ni[:, 0] % 7).sum()
             return acc
+
+        if chain == 1:
+            # unchained mode: one kernel per call on ROTATING distinct
+            # matrices (two kh=256 pallas_calls chained in one XLA program
+            # hit a TPU-internal error; standalone calls are fine — see
+            # BASELINE.md wide-k study). Distinct inputs per call keep the
+            # tunnel's dispatch cache honest.
+            def make_unchained(op, k=k):
+                cnt = {"i": 0}
+
+                def f(ms):
+                    x = ms[cnt["i"] % len(ms)]
+                    cnt["i"] += 1
+                    if op == "pallas":
+                        v, i = topk_pallas(x, k, select_min=True)
+                        return v[:, k - 1].sum() + (i[:, 0] % 7).sum()
+                    nv, ni = lax.top_k(-x, k)
+                    return (-nv)[:, k - 1].sum() + (ni[:, 0] % 7).sum()
+                return f
+
+            chain_pallas = make_unchained("pallas")
+            chain_lax = make_unchained("lax")
 
         variants = {"pallas": chain_pallas, "lax": chain_lax}
         # correctness spot-check before timing

@@ -62,11 +62,14 @@ def select_k(values, k: int, select_min: bool = True, indices=None):
     # floats: the kernel ranks after an f32 cast, so under jax_enable_x64 a
     # float64 row whose entries differ only beyond f32 precision would be
     # silently misranked vs the exact lax.top_k path.
-    # k in (64, 256] (the r05 bitonic-merge wide path, ops/topk.py) is kept
-    # OFF this dispatch until the bench/topk_wide_ab.py A/B on hardware
-    # justifies it — the gate below must only widen with a measurement
-    # (BASELINE.md "Round-5 wide-k selector study")
-    if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 64
+    # k <= 128 includes the r05 bitonic-merge wide path (ops/topk.py),
+    # measured 3.06x lax.top_k at (10k, 65k) k=128 in-process
+    # (BASELINE.md "Round-5 wide-k selector study"). 128 < k <= 256 also
+    # measured ahead (1.5-1.7x) but is NOT dispatched: two kh=256 kernel
+    # instances inside one XLA program hit a TPU-internal error (standalone
+    # calls are fine — callers can invoke ops.topk_pallas directly), and
+    # this dispatch can be embedded anywhere.
+    if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 128
             and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         from ..ops.topk import topk_pallas
 

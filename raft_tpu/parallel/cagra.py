@@ -18,6 +18,7 @@ top-k candidates.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -110,13 +111,34 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
     seed_pool = resolve_seed_pool(params)  # _cagra_search clamps to shard rows
     hop_impl = resolve_hop_impl(
         params, index.graph.shape[-1], index.dim)
-    inner = index.metric == DistanceType.InnerProduct
 
-    def step(data, graph, q):
-        shard = CagraIndex(dataset=data[0], graph=graph[0], metric=index.metric)
-        d_loc, i_loc = _cagra_search(shard, q, as_key(params.seed), k, itopk,
-                                     max_iter, int(params.search_width),
-                                     sqrt_out, seed_pool, hop_impl)
+    mesh, axis = comms.mesh, comms.axis
+    args = (
+        shard_along(mesh, axis, index.dataset),
+        shard_along(mesh, axis, index.graph),
+        replicated(mesh, queries),
+    )
+    fn = _cagra_search_fn(comms, int(k), int(itopk), int(max_iter),
+                          int(params.search_width), bool(sqrt_out),
+                          int(seed_pool), hop_impl, index.metric,
+                          int(rows))
+    return fn(*args, replicated(mesh, as_key(params.seed)))
+
+
+@functools.lru_cache(maxsize=256)
+def _cagra_search_fn(comms: Comms, k: int, itopk: int, max_iter: int,
+                     width: int, sqrt_out: bool, seed_pool: int,
+                     hop_impl: str, metric, rows: int):
+    """Memoized jitted program per static config (see parallel/knn._knn_fn
+    — a fresh jax.jit wrapper per call forces a retrace per search)."""
+    size = comms.size()
+    inner = metric == DistanceType.InnerProduct
+
+    def step(data, graph, q, key):
+        shard = CagraIndex(dataset=data[0], graph=graph[0], metric=metric)
+        d_loc, i_loc = _cagra_search(shard, q, key, k, itopk,
+                                     max_iter, width, sqrt_out, seed_pool,
+                                     hop_impl)
         i_glob = jnp.where(i_loc >= 0,
                            i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
         d_all = comms.allgather(d_loc)
@@ -126,15 +148,9 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
         i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
         return _select_k(d_flat, i_flat, k, not inner)
 
-    mesh, axis = comms.mesh, comms.axis
-    args = (
-        shard_along(mesh, axis, index.dataset),
-        shard_along(mesh, axis, index.graph),
-        replicated(mesh, queries),
-    )
-    fn = comms.shard_map(
+    axis = comms.axis
+    return jax.jit(comms.shard_map(
         step,
-        in_specs=(P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis), P(), P()),
         out_specs=(P(), P()),
-    )
-    return jax.jit(fn)(*args)
+    ))

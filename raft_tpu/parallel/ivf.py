@@ -26,6 +26,8 @@ drivers:
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -81,6 +83,37 @@ def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
     )
 
 
+@functools.lru_cache(maxsize=256)
+def _flat_search_fn(comms: Comms, n_probes: int, k: int, metric,
+                    split_factor: float, data_kind: str):
+    """Memoized jitted program per static config (see parallel/knn._knn_fn:
+    a fresh jax.jit wrapper per call was measured as 38-45% overhead)."""
+    size = comms.size()
+    inner = metric == DistanceType.InnerProduct
+
+    def step(centers, data, ids, norms, sizes, q):
+        shard = IvfFlatIndex(centers, data, ids, norms, sizes, metric,
+                             split_factor, data_kind)
+        d_loc, i_loc = _ivf_search(
+            shard, q, n_probes, k,
+            query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
+            metric=metric,
+        )
+        d_all = comms.allgather(d_loc)  # (S, m, k) over ICI
+        i_all = comms.allgather(i_loc)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, not inner)
+
+    axis = comms.axis
+    return jax.jit(comms.shard_map(
+        step,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+        out_specs=(P(), P()),
+    ))
+
+
 def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: int):
     """Distributed IVF-Flat search (multi-chip analogue of ivf_flat.search).
 
@@ -101,22 +134,6 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
     lists_per_shard = L // size
     n_probes = min(params.n_probes, lists_per_shard)
     expects(0 < k <= n_probes * index.capacity, "k exceeds per-shard candidate pool")
-    inner = index.metric == DistanceType.InnerProduct
-
-    def step(centers, data, ids, norms, sizes, q):
-        shard = IvfFlatIndex(centers, data, ids, norms, sizes, index.metric,
-                             index.split_factor, index.data_kind)
-        d_loc, i_loc = _ivf_search(
-            shard, q, n_probes, k,
-            query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
-            metric=index.metric,
-        )
-        d_all = comms.allgather(d_loc)  # (S, m, k) over ICI
-        i_all = comms.allgather(i_loc)
-        m = q.shape[0]
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, not inner)
 
     mesh, axis = comms.mesh, comms.axis
     args = (
@@ -127,12 +144,9 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
         shard_along(mesh, axis, index.list_sizes),
         replicated(mesh, queries),
     )
-    fn = comms.shard_map(
-        step,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
-        out_specs=(P(), P()),
-    )
-    return jax.jit(fn)(*args)
+    fn = _flat_search_fn(comms, int(n_probes), int(k), index.metric,
+                         float(index.split_factor), index.data_kind)
+    return fn(*args)
 
 
 def _pad_pq_lists(index, size: int):
@@ -220,7 +234,6 @@ def search_pq(comms: Comms, params, index, queries, k: int,
         budget_bytes=res.workspace_bytes,
         max_query_tile=128,
     )
-    inner = index.metric == DistanceType.InnerProduct
     per_cluster = index.codebook_kind == "per_cluster"
     expects(params.lut_dtype in ("float32", "bfloat16", "int8"),
             "lut_dtype must be 'float32', 'bfloat16' or 'int8', got %r",
@@ -236,32 +249,13 @@ def search_pq(comms: Comms, params, index, queries, k: int,
             "the distributed search runs the tiled scan order; "
             "scan_order=%r is single-chip only", params.scan_order)
 
-    def step(centers, centers_rot, codebooks, codes, ids, sizes, consts, q):
-        shard = IvfPqIndex(
-            centers, centers_rot, index.rotation, codebooks, codes, ids, sizes,
-            list_consts=consts,
-            metric=index.metric, codebook_kind=index.codebook_kind,
-            pq_bits=index.pq_bits, split_factor=index.split_factor,
-            pq_split=index.pq_split)
-        d_loc, i_loc = _pq_search(
-            shard, q, n_probes, k,
-            query_tile=query_tile, probe_chunk=probe_chunk,
-            metric=index.metric, codebook_kind=index.codebook_kind,
-            lut_dtype=params.lut_dtype, scan_impl=scan_impl)
-        d_all = comms.allgather(d_loc)
-        i_all = comms.allgather(i_loc)
-        m = q.shape[0]
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, not inner)
-
     mesh, axis = comms.mesh, comms.axis
-    cb_spec = P(axis) if per_cluster else P()
     cb_arg = (shard_along(mesh, axis, index.codebooks) if per_cluster
               else replicated(mesh, index.codebooks))
     args = (
         shard_along(mesh, axis, index.centers),
         shard_along(mesh, axis, index.centers_rot),
+        replicated(mesh, index.rotation),
         cb_arg,
         shard_along(mesh, axis, index.list_codes),
         shard_along(mesh, axis, index.list_ids),
@@ -269,12 +263,55 @@ def search_pq(comms: Comms, params, index, queries, k: int,
         shard_along(mesh, axis, index.list_consts),
         replicated(mesh, queries),
     )
-    fn = comms.shard_map(
+    fn = _pq_search_fn(comms, int(n_probes), int(k), int(query_tile),
+                       int(probe_chunk), index.metric, index.codebook_kind,
+                       int(index.pq_bits), float(index.split_factor),
+                       bool(index.pq_split), params.lut_dtype, scan_impl)
+    return fn(*args)
+
+
+@functools.lru_cache(maxsize=256)
+def _pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
+                  probe_chunk: int, metric, codebook_kind: str, pq_bits: int,
+                  split_factor: float, pq_split: bool, lut_dtype: str,
+                  scan_impl: str):
+    """Memoized jitted PQ-search program (see _flat_search_fn); the
+    rotation travels as a replicated argument, not a closure constant, so
+    two indexes of the same config share one compiled program."""
+    from ..neighbors.ivf_pq import IvfPqIndex, _pq_search
+
+    size = comms.size()
+    inner = metric == DistanceType.InnerProduct
+    per_cluster = codebook_kind == "per_cluster"
+
+    def step(centers, centers_rot, rotation, codebooks, codes, ids, sizes,
+             consts, q):
+        shard = IvfPqIndex(
+            centers, centers_rot, rotation, codebooks, codes, ids, sizes,
+            list_consts=consts,
+            metric=metric, codebook_kind=codebook_kind,
+            pq_bits=pq_bits, split_factor=split_factor,
+            pq_split=pq_split)
+        d_loc, i_loc = _pq_search(
+            shard, q, n_probes, k,
+            query_tile=query_tile, probe_chunk=probe_chunk,
+            metric=metric, codebook_kind=codebook_kind,
+            lut_dtype=lut_dtype, scan_impl=scan_impl)
+        d_all = comms.allgather(d_loc)
+        i_all = comms.allgather(i_loc)
+        m = q.shape[0]
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, not inner)
+
+    axis = comms.axis
+    cb_spec = P(axis) if per_cluster else P()
+    return jax.jit(comms.shard_map(
         step,
-        in_specs=(P(axis), P(axis), cb_spec, P(axis), P(axis), P(axis), P(axis), P()),
+        in_specs=(P(axis), P(axis), P(), cb_spec, P(axis), P(axis), P(axis),
+                  P(axis), P()),
         out_specs=(P(), P()),
-    )
-    return jax.jit(fn)(*args)
+    ))
 
 
 # ---------------------------------------------------------------------------

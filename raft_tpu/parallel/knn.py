@@ -17,6 +17,8 @@ never see the shard-divisibility invariant.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -28,6 +30,53 @@ from ..matrix.select_k import _select_k
 from ..neighbors.brute_force import _bf_knn, _bf_knn_fused, _fused_eligible
 
 __all__ = ["knn"]
+
+
+@functools.lru_cache(maxsize=256)
+def _knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
+            tile: int, inner_tile: int, compute: str, use_fused: bool,
+            shard_rows: int, has_keep: bool):
+    """Memoized jitted program per static config. The drivers used to build
+    a fresh closure + jax.jit wrapper on every call, which forced a full
+    retrace per search — measured as a 38-45% driver overhead on a 1-device
+    mesh (BASELINE.md "Round-5 parallel-driver overhead"); with the program
+    cached the overhead is the collectives' true cost."""
+    size = comms.size()
+    select_min = mt != DistanceType.InnerProduct
+
+    def local_search(x_shard, q, keep_shard):
+        if use_fused:
+            return _bf_knn_fused(x_shard, q, k, mt, compute, keep_shard)
+        comp = "float32" if compute == "float32x3" else compute
+        return _bf_knn(x_shard, q, k, mt, metric_arg,
+                       min(tile, q.shape[0]), inner_tile, keep_shard,
+                       compute=comp)
+
+    def merge(d_loc, i_loc, m):
+        i_glob = jnp.where(i_loc >= 0,
+                           i_loc + comms.rank().astype(jnp.int32) * shard_rows,
+                           -1)
+        d_all = comms.allgather(d_loc)
+        i_all = comms.allgather(i_glob)
+        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+        return _select_k(d_flat, i_flat, k, select_min)
+
+    if has_keep:
+        def step(x_shard, keep_shard, q):
+            d_loc, i_loc = local_search(x_shard, q, keep_shard)
+            return merge(d_loc, i_loc, q.shape[0])
+
+        return jax.jit(comms.shard_map(
+            step, in_specs=(P(comms.axis), P(comms.axis), P()),
+            out_specs=(P(), P())))
+
+    def step(x_shard, q):
+        d_loc, i_loc = local_search(x_shard, q, None)
+        return merge(d_loc, i_loc, q.shape[0])
+
+    return jax.jit(comms.shard_map(
+        step, in_specs=(P(comms.axis), P()), out_specs=(P(), P())))
 
 
 def knn(comms: Comms, dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
@@ -51,50 +100,17 @@ def knn(comms: Comms, dataset, queries, k: int, metric="sqeuclidean", metric_arg
             "k=%d must be <= per-shard rows (%d rows over %d shards)",
             k, shard_rows, size)
     mt = resolve_metric(metric)
-    select_min = mt != DistanceType.InnerProduct
     keep = None
     if n_pad != n:
         dataset = jnp.pad(dataset, ((0, n_pad - n), (0, 0)))
         keep = jnp.arange(n_pad) < n
     use_fused = _fused_eligible(mt, int(k), shard_rows, d, "exact", compute)
-
-    def local_search(x_shard, q, keep_shard):
-        if use_fused:
-            return _bf_knn_fused(x_shard, q, k, mt, compute, keep_shard)
-        comp = "float32" if compute == "float32x3" else compute
-        return _bf_knn(x_shard, q, k, mt, metric_arg,
-                       min(tile, q.shape[0]), inner_tile, keep_shard,
-                       compute=comp)
-
-    def merge(d_loc, i_loc, m):
-        # shard-local → global ids; -1 (masked-slot) sentinels stay -1
-        i_glob = jnp.where(i_loc >= 0,
-                           i_loc + comms.rank().astype(jnp.int32) * shard_rows,
-                           -1)
-        # candidates ride ICI: (size, m, k) each
-        d_all = comms.allgather(d_loc)
-        i_all = comms.allgather(i_glob)
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, select_min)
-
     x_sharded = shard_along(comms.mesh, comms.axis, dataset)
     q_repl = replicated(comms.mesh, queries)
+    fn = _knn_fn(comms, int(k), mt, float(metric_arg), int(tile),
+                 int(inner_tile), compute, bool(use_fused), int(shard_rows),
+                 keep is not None)
     if keep is None:
-        def step(x_shard, q):
-            d_loc, i_loc = local_search(x_shard, q, None)
-            return merge(d_loc, i_loc, q.shape[0])
-
-        fn = comms.shard_map(step, in_specs=(P(comms.axis), P()),
-                             out_specs=(P(), P()))
-        return jax.jit(fn)(x_sharded, q_repl)
-
+        return fn(x_sharded, q_repl)
     keep_sh = shard_along(comms.mesh, comms.axis, keep)
-
-    def step(x_shard, keep_shard, q):
-        d_loc, i_loc = local_search(x_shard, q, keep_shard)
-        return merge(d_loc, i_loc, q.shape[0])
-
-    fn = comms.shard_map(step, in_specs=(P(comms.axis), P(comms.axis), P()),
-                         out_specs=(P(), P()))
-    return jax.jit(fn)(x_sharded, keep_sh, q_repl)
+    return fn(x_sharded, keep_sh, q_repl)
