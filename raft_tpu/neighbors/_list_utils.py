@@ -7,13 +7,16 @@ padded scatter, and the search-time (query_tile, probe_chunk) sizing plan.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 
 from ..distance.fused_nn import _fused_l2_nn
 from ..distance.types import DistanceType
 
 __all__ = ["round_up", "list_positions", "plan_search_tiles", "assign_to_lists",
-           "split_oversized", "bound_capacity"]
+           "split_oversized", "spatial_split_key", "bound_capacity"]
 
 
 def round_up(x: int, mult: int) -> int:
@@ -46,16 +49,24 @@ def list_positions(labels, n_lists: int):
     return pos, counts.astype(jnp.int32)
 
 
-def split_oversized(labels, n_lists: int, cap_target: int):
-    """Split lists larger than ``cap_target`` into sub-lists that share the
-    parent's center.
+def split_oversized(labels, n_lists: int, cap_target: int, order_key=None):
+    """Split lists larger than ``cap_target`` into sub-lists.
 
     The padded layout prices every list at the MAX size, so one hot cluster
     inflates all scans; bounding capacity by sub-list splitting is the
     coarse-grained analogue of the reference's fixed 32-vector interleaved
-    groups (ivf_flat_build.cuh:135-153). Sub-lists duplicate their parent's
-    coarse center, so a query's coarse top-k naturally ranks them adjacently
-    (identical scores) and probes them together.
+    groups (ivf_flat_build.cuh:135-153).
+
+    ``order_key`` (optional, (n,) float) controls HOW members divide among a
+    list's sub-lists: with None, by input order (arbitrary — fine when
+    sub-lists share the parent's center and are probed together); with a
+    per-row spatial key (e.g. projection on the list's principal axis,
+    :func:`spatial_split_key`), each sub-list is a spatially coherent SLAB,
+    so a caller that re-centers sub-lists on their member means gets
+    differentiated coarse scores and queries probe only nearby slabs — the
+    fix for Zipf-population data, where an order-split mega-cluster
+    scattered every query's neighbors uniformly over ~population/cap
+    identical-score sub-lists (BASELINE.md "Round-5 heavytail family").
 
     Returns ``(new_labels (n,), rep (n_lists,) host int array)`` where
     ``rep[l]`` is how many sub-lists list ``l`` became (all 1 = no change);
@@ -64,24 +75,81 @@ def split_oversized(labels, n_lists: int, cap_target: int):
     """
     import numpy as np
 
-    pos, counts = list_positions(labels, n_lists)
-    counts_h = np.asarray(counts)
+    if order_key is None:
+        pos, counts = list_positions(labels, n_lists)
+        counts_h = np.asarray(counts)
+    else:
+        # within-list rank by the spatial key: one lexicographic sort by
+        # (label, key) — the proj-ordered twin of list_positions
+        n = labels.shape[0]
+        idx = jnp.arange(n, dtype=jnp.int32)
+        _, _, s_idx = jax.lax.sort(
+            (labels.astype(jnp.int32), order_key.astype(jnp.float32), idx),
+            num_keys=2)
+        counts = jnp.bincount(labels, length=n_lists)
+        starts = jnp.cumsum(counts) - counts
+        pos_sorted = (jnp.arange(n, dtype=jnp.int32)
+                      - jnp.take(starts, jnp.take(labels, s_idx)).astype(jnp.int32))
+        pos = jnp.zeros((n,), jnp.int32).at[s_idx].set(pos_sorted)
+        counts_h = np.asarray(counts)
     rep = np.maximum(1, -(-counts_h // cap_target)).astype(np.int64)
     base = np.concatenate([[0], np.cumsum(rep)[:-1]]).astype(np.int32)
     new_labels = jnp.asarray(base)[labels] + (pos // cap_target).astype(jnp.int32)
     return new_labels, rep
 
 
-def bound_capacity(labels, n_lists: int, factor: float = 1.3):
+def spatial_split_key(x, labels, n_lists: int, n_iters: int = 3):
+    """Per-row projection onto its list's principal axis — the spatial
+    order key for :func:`split_oversized`. Fully vectorized across lists:
+    per-list means by segment sum, then ``n_iters`` power iterations of the
+    per-list covariance action (each iteration is two passes over (n, d)),
+    then the scalar projection. The reference reaches the same goal through
+    hierarchical balanced k-means (detail/kmeans_balanced.cuh
+    build_hierarchical); a principal-axis slab split is the one-shot TPU
+    form (slabs are contiguous ranks, so the split stays exactly
+    capacity-balanced)."""
+    return _spatial_key_impl(x, labels, n_lists, n_iters)
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "n_iters"))
+def _spatial_key_impl(x, labels, n_lists: int, n_iters: int):
+    xf = x.astype(jnp.float32)
+    n, d = xf.shape
+    lab = labels.astype(jnp.int32)
+    onehot_sum = jnp.zeros((n_lists, d), jnp.float32).at[lab].add(xf)
+    counts = jnp.zeros((n_lists,), jnp.float32).at[lab].add(1.0)
+    means = onehot_sum / jnp.maximum(counts, 1.0)[:, None]
+    xc = xf - means[lab]
+    key = jax.random.key(0)
+    v = jax.random.normal(key, (n_lists, d), jnp.float32)
+
+    def body(i, v):
+        w = jnp.sum(xc * v[lab], axis=1)                     # (n,)
+        v2 = jnp.zeros((n_lists, d), jnp.float32).at[lab].add(
+            w[:, None] * xc)
+        return v2 / jnp.maximum(
+            jnp.linalg.norm(v2, axis=1, keepdims=True), 1e-20)
+
+    v = jax.lax.fori_loop(0, n_iters, body, v)
+    return jnp.sum(xc * v[lab], axis=1)
+
+
+def bound_capacity(labels, n_lists: int, factor: float = 1.3, x=None):
     """Shared capacity policy for IVF fills: lists larger than ``factor`` x
     the mean split into sub-lists (see :func:`split_oversized`); otherwise
     capacity is the max size rounded to the sublane tile. Lower factors cut
     the padded-gather bytes every scan pays (the 1M-scale search bottleneck)
     at the cost of more sub-lists competing for probe slots.
 
-    Returns ``(labels, rep, n_lists, capacity)`` where ``rep`` is None when no
-    splitting happened, else the host repeat-count array for center-indexed
-    arrays (``np.repeat(arr, rep, axis=0)``).
+    ``x`` (optional, (n, d)): when given, oversized lists split SPATIALLY
+    along their principal axis (see :func:`split_oversized`); the caller
+    should then re-center split sub-lists on their member means.
+
+    Returns ``(labels, rep, n_lists, capacity, spatial)`` where ``rep`` is
+    None when no splitting happened, else the host repeat-count array for
+    center-indexed arrays (``np.repeat(arr, rep, axis=0)``), and ``spatial``
+    is None or a host bool array over ORIGINAL lists marking which were
+    slab-ordered (the caller should recenter exactly those lists' children).
     """
     import numpy as np
 
@@ -90,9 +158,32 @@ def bound_capacity(labels, n_lists: int, factor: float = 1.3):
     mean_size = max(labels.shape[0] / n_lists, 1.0)
     cap_target = round_up(max(int(mean_size * factor), 8), 8)
     if max_size <= cap_target:
-        return labels, None, n_lists, round_up(max_size, 8)
-    new_labels, rep = split_oversized(labels, n_lists, cap_target)
-    return new_labels, rep, int(rep.sum()), cap_target
+        return labels, None, n_lists, round_up(max_size, 8), None
+    # spatial splitting only for lists that shatter SEVERELY (>= 4
+    # sub-lists — a mega-cluster the coarse trainer could not divide, e.g.
+    # n_lists below the natural cluster count on population-skewed data).
+    # Mild splits keep the order split + duplicated centers bit-for-bit:
+    # siblings tie in coarse score and are probed together, and an r05 A/B
+    # measured the spatial form ~0.001-0.003 recall WORSE there
+    # (recentring perturbs probe ranking for no coverage gain), while on a
+    # shattered mega-cluster the order split caps recall at ~n_probes/rep
+    # (tests/test_ivf_flat.py::test_spatial_split_recall_on_skewed_population).
+    # Selectivity is PER LIST: the spatial key applies only to severe
+    # lists' rows (everyone else keys to 0, and the stable sort preserves
+    # their input order exactly), and `spatial` reports which original
+    # lists were slab-ordered so the caller recenters exactly those.
+    import numpy as np
+
+    order_key = None
+    spatial = None
+    severe_h = np.asarray(sizes) >= 4 * cap_target
+    if x is not None and severe_h.any():
+        proj = spatial_split_key(x, labels, n_lists)
+        severe = jnp.asarray(severe_h)
+        order_key = jnp.where(severe[labels], proj, 0.0)
+        spatial = severe_h
+    new_labels, rep = split_oversized(labels, n_lists, cap_target, order_key)
+    return new_labels, rep, int(rep.sum()), cap_target, spatial
 
 
 def pq_scan_bytes_per_probe_row(capacity: int, pq_dim: int, n_codes: int) -> int:

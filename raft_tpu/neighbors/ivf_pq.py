@@ -726,7 +726,7 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
     # Residuals/codes were computed against the parent center, which
     # sub-lists share, so codes stay valid.
     sf = index.split_factor if split_factor is None else split_factor
-    labels, rep, n_lists, capacity = bound_capacity(labels, index.n_lists, sf)
+    labels, rep, n_lists, capacity, _ = bound_capacity(labels, index.n_lists, sf)
     centers, centers_rot, codebooks = index.centers, index.centers_rot, index.codebooks
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
