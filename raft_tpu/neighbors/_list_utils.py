@@ -159,9 +159,17 @@ def bound_capacity(labels, n_lists: int, factor: float = 1.3, x=None):
     cap_target = round_up(max(int(mean_size * factor), 8), 8)
     if max_size <= cap_target:
         return labels, None, n_lists, round_up(max_size, 8), None
-    # spatial splitting only for lists that shatter SEVERELY (>= 4
+    # spatial splitting only for lists that shatter SEVERELY (>= 8
     # sub-lists — a mega-cluster the coarse trainer could not divide, e.g.
     # n_lists below the natural cluster count on population-skewed data).
+    # The 8x-size threshold is a measured compromise: it sits just above
+    # the hot-list tail balanced k-means leaves on ordinary clustered data
+    # (isotropic-1M: max list 7.4x cap, 3 lists past 4x; recentring those
+    # measured -0.0014 recall on the flagship row), at the cost of leaving
+    # lists in the (4x, 8x) band on the order split, where the
+    # ~n_probes/rep recall cap is partial (rep up to 8 at the default
+    # p=8) rather than the catastrophic many-fold cap this path exists
+    # to fix.
     # Mild splits keep the order split + duplicated centers bit-for-bit:
     # siblings tie in coarse score and are probed together, and an r05 A/B
     # measured the spatial form ~0.001-0.003 recall WORSE there
@@ -172,11 +180,9 @@ def bound_capacity(labels, n_lists: int, factor: float = 1.3, x=None):
     # lists' rows (everyone else keys to 0, and the stable sort preserves
     # their input order exactly), and `spatial` reports which original
     # lists were slab-ordered so the caller recenters exactly those.
-    import numpy as np
-
     order_key = None
     spatial = None
-    severe_h = np.asarray(sizes) >= 4 * cap_target
+    severe_h = np.asarray(sizes) >= 8 * cap_target
     if x is not None and severe_h.any():
         proj = spatial_split_key(x, labels, n_lists)
         severe = jnp.asarray(severe_h)

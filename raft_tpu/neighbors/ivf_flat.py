@@ -324,7 +324,7 @@ def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
     import numpy as np
 
     # shared capacity policy: hot lists split into sub-lists. SEVERELY
-    # oversized lists (>= 4x the cap — a mega-cluster the coarse trainer
+    # oversized lists (>= 8x the cap — a mega-cluster the coarse trainer
     # could not divide) split SPATIALLY into principal-axis slabs and get
     # their OWN member-mean centers below; mild splits keep the order
     # split + duplicated centers (bound_capacity decides — see its
