@@ -37,8 +37,9 @@ _KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
 
 
 def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
-           index_params: Any | None = None, search_params: Any | None = None,
-           cache_dir: str | None = None, seed: int = 0) -> dict:
+           dtype: str = "float32", index_params: Any | None = None,
+           search_params: Any | None = None, cache_dir: str | None = None,
+           seed: int = 0) -> dict:
     """Compile-warm one index kind at (n, d) build / (queries, d) search.
 
     Enables the persistent compilation cache (``cache_dir`` or the default
@@ -47,7 +48,15 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
     ``{"build_s": ..., "search_s": ..., "cache_dir": ...}``. Pass the same
     ``index_params``/``search_params`` you will use in production — the
     cache keys on static config (n_lists, pq_dim, itopk, ...), so a warmup
-    with different params warms different programs.
+    with different params warms different programs. The same holds for
+    ``k``: the search is warmed at EXACTLY the ``k`` passed here (k is a
+    static argument of every search program), so a production pipeline that
+    searches at several widths — e.g. IVF-PQ candidates at k=40 feeding a
+    refine at k=10 — must call warmup once per width.
+
+    ``dtype`` ("float32" | "int8" | "uint8") warms the byte-dataset search
+    paths: random data is drawn in the target dtype, so the s8 kernels and
+    byte list layouts compile exactly as production will run them.
     """
     import jax
     import jax.numpy as jnp
@@ -57,10 +66,17 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
 
     expects(kind in _KINDS, "unknown index kind %r (one of %s)", kind,
             ", ".join(_KINDS))
+    expects(dtype in ("float32", "int8", "uint8"),
+            "dtype must be 'float32', 'int8' or 'uint8', got %r", dtype)
     cache = enable_compilation_cache(cache_dir)
     kd, kq = jax.random.split(jax.random.key(seed))
-    x = jax.random.uniform(kd, (n, d), jnp.float32)
-    q = jax.random.uniform(kq, (queries, d), jnp.float32)
+    if dtype == "float32":
+        x = jax.random.uniform(kd, (n, d), jnp.float32)
+        q = jax.random.uniform(kq, (queries, d), jnp.float32)
+    else:
+        lo, hi = (-128, 128) if dtype == "int8" else (0, 256)
+        x = jax.random.randint(kd, (n, d), lo, hi, jnp.int32).astype(dtype)
+        q = jax.random.randint(kq, (queries, d), lo, hi, jnp.int32).astype(dtype)
     jax.block_until_ready((x, q))
 
     t0 = time.perf_counter()
@@ -84,9 +100,14 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
             index_params or ivf_pq.IndexParams(
                 n_lists=1024, pq_bits=4, pq_dim=min(64, d), seed=seed), x)
         jax.block_until_ready(idx.list_codes)
+        # the caller's k, EXACTLY: the compilation cache is keyed by HLO and
+        # k is a static arg of _pq_search, so the old max(k, 40) override
+        # left the production k=10 program cold (ADVICE r5 medium).
+        # Pipelines that also search a refine-candidate width (e.g. k=40
+        # feeding refine to 10) warm that width with a second warmup call.
         searcher = lambda: ivf_pq.search(
             search_params or ivf_pq.SearchParams(
-                n_probes=8, lut_dtype="bfloat16"), idx, q, max(k, 40))
+                n_probes=8, lut_dtype="bfloat16"), idx, q, k)
     else:  # cagra
         from .neighbors import cagra
 

@@ -39,16 +39,19 @@ __all__ = [
 #   raft_tpu/3: ivf_pq carries pq_split + list_consts (nibble-split pq8).
 #   raft_tpu/4: cagra carries seed_pool_hint (measured search autotune).
 #   raft_tpu/5: ivf_flat carries data_kind (int8/uint8 list storage).
-SERIALIZATION_VERSION = "raft_tpu/5"
+#   raft_tpu/6: ivf_pq + cagra carry data_kind (int8/uint8 byte datasets).
+SERIALIZATION_VERSION = "raft_tpu/6"
 
-# Older versions each tag can still READ (only ivf_flat's layout changed in
-# raft_tpu/5, cagra's in /4, ivf_pq's in /3 — bumping the global version
+# Older versions each tag can still READ (ivf_pq's and cagra's layouts
+# changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
 # must not force rebuilds of unchanged formats; loaders branch on the
 # returned version where a field was added).
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
-    "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4"}),
-    "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4"}),
-    "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4"}),
+    "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
+                           "raft_tpu/5"}),
+    "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5"}),
+    "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
+                        "raft_tpu/5"}),
 }
 
 

@@ -28,6 +28,20 @@ __all__ = ["select_k"]
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min"))
 def _select_k(values, in_idx, k: int, select_min: bool):
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        # integer scores (e.g. exact int32 distances from the s8 MXU search
+        # paths): ~v is the wrap-free order flip in the SAME dtype for both
+        # families (unsigned: max - v; signed: -v - 1 — unlike negation,
+        # which wraps at INT_MIN, and unlike a python-int `max - v`, which
+        # overflows the weak-typed i32 scalar path for uint32). Output
+        # values are gathered from the input, so they keep the caller's
+        # dtype and exact magnitudes.
+        key = ~values if select_min else values
+        _, top_i = lax.top_k(key, k)
+        top_v = jnp.take_along_axis(values, top_i, axis=1)
+        if in_idx is not None:
+            top_i = jnp.take_along_axis(in_idx, top_i, axis=1)
+        return top_v, top_i.astype(jnp.int32)
     v = -values if select_min else values
     top_v, top_i = lax.top_k(v, k)  # ties resolved by lowest index, like the ref
     if select_min:
@@ -69,6 +83,10 @@ def select_k(values, k: int, select_min: bool = True, indices=None):
     # instances inside one XLA program hit a TPU-internal error (standalone
     # calls are fine — callers can invoke ops.topk_pallas directly), and
     # this dispatch can be embedded anywhere.
+    # Integer values (exact int32 scores from the s8 search paths, uint8
+    # payload matrices, ...) also stay on the lax.top_k path: the Pallas
+    # selector ranks after an f32 cast, which would misrank int32 values
+    # differing only beyond 2^24; _select_k handles them exactly.
     if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 128
             and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         from ..ops.topk import topk_pallas

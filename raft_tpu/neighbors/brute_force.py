@@ -49,6 +49,24 @@ def _as_signed(x):
     return x
 
 
+def _coerce_queries(data_kind: str, queries):
+    """Move queries into a byte index's storage domain — the search-side
+    half of the _as_signed contract, shared by every index type
+    (ivf_flat/ivf_pq/cagra, single-chip and distributed): integer queries
+    must match the index's original dtype and shift with it; float queries
+    against a shifted-uint8 index shift by -128 (L2-invariant)."""
+    if data_kind not in ("int8", "uint8"):
+        return queries
+    if queries.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8)):
+        expects(str(queries.dtype) == data_kind,
+                "this index stores %s vectors; got %s queries",
+                data_kind, queries.dtype)
+        return _as_signed(queries).astype(jnp.float32)
+    if data_kind == "uint8":
+        return queries.astype(jnp.float32) - 128.0
+    return queries
+
+
 def _bf_knn_s8(dataset, queries, k, metric, keep_mask):
     """int8 MXU dispatch (~2x bf16 peak, 1-byte operand DMAs). Distances are
     EXACT integers for d <= ~340 (see ops/fused_knn mode='s8')."""

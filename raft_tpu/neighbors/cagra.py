@@ -153,9 +153,16 @@ class CagraIndex:
     """Reference: cagra::index (cagra_types.hpp:123-220) — dataset + fixed
     -degree neighbor graph."""
 
-    dataset: jax.Array  # (n, d)
+    dataset: jax.Array  # (n, d) — f32, or int8 for byte datasets (the
+    # reference's dtype-generic cagra::index<T>: int8/uint8 datasets store
+    # native bytes, quartering the hop loop's vector-gather traffic; uint8
+    # is held shifted by -128 in the s8 domain — L2 is shift-invariant and
+    # queries shift the same way at search)
     graph: jax.Array  # (n, graph_degree) int32
     metric: DistanceType = DistanceType.L2Expanded
+    # "float32" | "int8" | "uint8": what the stored dataset IS (uint8 kinds
+    # hold shifted s8 bytes); governs extend/search query coercion
+    data_kind: str = "float32"
     # measured at build time from the knn graph's neighbor-distance jump
     # profile: the seed-pool size that covers the data's local modes
     # (0 = no clump structure detected; SearchParams.seed_pool=-1 consumes
@@ -182,11 +189,12 @@ class CagraIndex:
         return self.graph.shape[1]
 
     def tree_flatten(self):
-        return (self.dataset, self.graph), self.metric
+        return (self.dataset, self.graph), (self.metric, self.data_kind)
 
     @classmethod
-    def tree_unflatten(cls, metric, children):
-        return cls(*children, metric=metric)
+    def tree_unflatten(cls, aux, children):
+        metric, kind = aux if isinstance(aux, tuple) else (aux, "float32")
+        return cls(*children, metric=metric, data_kind=kind)
 
 
 def knn_build_plan(params: IndexParams, n: int, d: int):
@@ -420,6 +428,13 @@ def _neighbor_dist_profile(x, knn_graph, sample_ids):
     return jnp.sort(d2, axis=1)
 
 
+# calibrated neighbor-distance jump threshold for clump detection (see
+# estimate_seed_pool's docstring for the r05 measurement); interpolated
+# into the decision AND the logs so the diagnostics always report the rule
+# actually applied (ADVICE r5 low)
+_SEED_JUMP_RATIO = 2.0
+
+
 def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
     """Measured seed-pool policy (the search-side twin of the r04
     build_n_probes autotune; reference analogue: adjust_search_params,
@@ -467,13 +482,13 @@ def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
     ratios = d2[:, 1:] / d2[:, :-1]
     jump = ratios.max(axis=1)
     pos = ratios.argmax(axis=1) + 1  # in-clump neighbor count before the jump
-    clumpy = jump >= 2.0  # measured calibration: see docstring
+    clumpy = jump >= _SEED_JUMP_RATIO  # measured calibration: see docstring
     frac = float(np.mean(clumpy))
     if frac < 0.5:
         logger.info("cagra seed_pool auto: no clump structure (%.0f%% of "
-                    "sampled rows show a >=2x neighbor-distance jump; "
+                    "sampled rows show a >=%.0fx neighbor-distance jump; "
                     "median max-ratio %.2f) — default pool", frac * 100,
-                    float(np.median(jump)))
+                    _SEED_JUMP_RATIO, float(np.median(jump)))
         return 0
     s = float(np.median(pos[clumpy])) + 1.0  # + self
     modes = n / s
@@ -483,14 +498,18 @@ def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
         logger.info("cagra seed_pool auto: clump size ~%.0f → ~%.0f modes — "
                     "default pool covers them", s, modes)
         return 0
-    logger.info("cagra seed_pool auto: %.0f%% of rows jump >=4x at median "
+    logger.info("cagra seed_pool auto: %.0f%% of rows jump >=%.0fx at median "
                 "position %.0f → ~%.0f local modes → seed_pool_hint=%d",
-                frac * 100, s, modes, pool)
+                frac * 100, _SEED_JUMP_RATIO, s, modes, pool)
     return pool
 
 
 def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIndex:
-    """Full CAGRA build (reference: cagra::build, cagra.cuh)."""
+    """Full CAGRA build (reference: cagra::build, cagra.cuh; the int8_t /
+    uint8_t instantiations map to byte datasets here: the index stores the
+    dataset in its native 8-bit dtype — uint8 shifted by -128 into the s8
+    domain, L2-invariant — and the whole build pipeline (IVF-PQ self-search,
+    exact refine, pruning) runs on the exact f32 image of those bytes)."""
     res = res or default_resources()
     x = jnp.asarray(dataset)
     expects(x.ndim == 2, "dataset must be (n, d)")
@@ -502,10 +521,17 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
                DistanceType.L2Unexpanded, DistanceType.L2SqrtUnexpanded),
         "cagra supports L2 metrics (reference parity), got %s", mt.name,
     )
+    kind = "float32"
+    if x.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8)):
+        from .brute_force import _as_signed
+
+        kind = str(x.dtype)
+        x = _as_signed(x)  # stored (and scored) in the shifted s8 domain
     knn_graph = build_knn_graph(params, x, res=res)
     hint = estimate_seed_pool(x, knn_graph, seed=params.seed)
     graph = optimize(knn_graph, params.graph_degree, res=res)
-    return CagraIndex(dataset=x, graph=graph, metric=mt, seed_pool_hint=hint)
+    return CagraIndex(dataset=x, graph=graph, metric=mt, data_kind=kind,
+                      seed_pool_hint=hint)
 
 
 # ---------------------------------------------------------------------------
@@ -601,7 +627,7 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         # the kernel emits the first hop's picks
         cw = width * deg
         zero_nbrs = jnp.full((m, cw), -1, jnp.int32)
-        zero_vecs = jnp.zeros((m, cw, d), jnp.float32)
+        zero_vecs = jnp.zeros((m, cw, d), data.dtype)
         bd, bi, bv, pick, nocand = cagra_hop(
             qf, bd, bi, bv, zero_nbrs, zero_vecs,
             jnp.zeros((m, cw), jnp.int32), itopk, width,
@@ -618,7 +644,10 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
             bd, bi, bv, pick, nocand, it = state
             safe = jnp.minimum(pick, n - 1)              # (m, width)
             nbrs = index.graph[safe].reshape(m, cw)      # (m, width*deg)
-            vecs = data[jnp.maximum(nbrs, 0)].astype(jnp.float32)
+            # native-dtype gather: byte datasets move 1 byte/dim into the
+            # kernel (a quarter of f32 DMA bytes); the f32 upcast happens
+            # INSIDE the kernel at the tile level (exact for 8-bit values)
+            vecs = data[jnp.maximum(nbrs, 0)]
             valid = jnp.repeat(1 - nocand, deg, axis=1)  # per-candidate
             bd, bi, bv, pick, nocand = cagra_hop(
                 qf, bd, bi, bv, nbrs, vecs, valid, itopk, width,
@@ -696,16 +725,20 @@ def resolve_seed_pool(params: SearchParams, hint: int = 0) -> int:
     return pool
 
 
-def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int) -> str:
+def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int,
+                     itemsize: int = 4) -> str:
     """Validate + resolve ``params.hop_impl`` (shared by the single-chip and
-    distributed searches — same eligibility rules, same clear errors)."""
+    distributed searches — same eligibility rules, same clear errors).
+    ``itemsize`` is the dataset element size: byte datasets stage a quarter
+    of the candidate-block VMEM, widening fused eligibility at high d."""
     from ..ops.cagra_hop import hop_backend_ok, hop_shapes_eligible
 
     expects(params.hop_impl in ("auto", "xla", "fused", "fused_arena"),
             "hop_impl must be 'auto', 'xla', 'fused' or 'fused_arena', "
             "got %r", params.hop_impl)
     eligible = (hop_backend_ok()[0] and hop_shapes_eligible(
-        params.itopk_size, graph_degree, params.search_width, dim))
+        params.itopk_size, graph_degree, params.search_width, dim,
+        itemsize=itemsize))
     if params.hop_impl == "auto":
         # fused_arena is the measured winner (r05 study, BASELINE.md):
         # 41-42k vs 32-33k XLA QPS at 1M itopk=32, identical 0.9714 recall
@@ -714,8 +747,13 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int) -> str:
         return "fused_arena" if eligible else "xla"
     if params.hop_impl in ("fused", "fused_arena"):
         expects(eligible, "hop_impl='fused' needs itopk + "
-                "search_width*graph_degree <= 128 and a TPU backend (or "
-                "RAFT_TPU_CAGRA_HOP_INTERPRET=1 for tests)")
+                "search_width*graph_degree <= 128, the staged candidate "
+                "block (128*search_width*graph_degree*d_pad*itemsize bytes, "
+                "double-buffered) within the kernel VMEM budget, and a TPU "
+                "backend (or RAFT_TPU_CAGRA_HOP_INTERPRET=1 for tests); "
+                "got itopk=%d width=%d degree=%d d=%d itemsize=%d",
+                params.itopk_size, params.search_width, graph_degree, dim,
+                itemsize)
     return params.hop_impl
 
 
@@ -723,15 +761,22 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int) -> str:
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
     cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD)."""
+    from .brute_force import _coerce_queries
+
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
     expects(k <= params.itopk_size, "k must be <= itopk_size (ref cagra_types.hpp:66)")
+    # byte indexes: integer queries must match the index dtype and shift
+    # with it; float queries against a uint8 index shift by -128 (same
+    # contract as ivf_flat/ivf_pq)
+    queries = _coerce_queries(index.data_kind, queries)
     itopk = params.itopk_size
     max_iter = resolve_max_iterations(params)
     sqrt_out = index.metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
     pool = resolve_seed_pool(params, index.seed_pool_hint)
-    impl = resolve_hop_impl(params, index.graph_degree, index.dim)
+    impl = resolve_hop_impl(params, index.graph_degree, index.dim,
+                            itemsize=index.dataset.dtype.itemsize)
     return _cagra_search(index, queries, as_key(params.seed), int(k),
                          int(itopk), int(max_iter),
                          int(params.search_width), sqrt_out, pool, impl)
@@ -743,6 +788,7 @@ def save(index: CagraIndex, path: str) -> None:
         serialize_header(f, "cagra")
         serialize_scalar(f, int(index.metric))
         serialize_scalar(f, int(index.seed_pool_hint))
+        serialize_scalar(f, index.data_kind)
         serialize_mdspan(f, index.dataset)
         serialize_mdspan(f, index.graph)
 
@@ -755,7 +801,11 @@ def load(path: str, res: Resources | None = None) -> CagraIndex:
         # with the default pool (correct, just not data-tuned)
         hint = deserialize_scalar(f) if ver not in (
             "raft_tpu/2", "raft_tpu/3") else 0
+        # raft_tpu/6 added data_kind (byte datasets); older files could
+        # only hold float data
+        kind = deserialize_scalar(f) if ver not in (
+            "raft_tpu/2", "raft_tpu/3", "raft_tpu/4", "raft_tpu/5") else "float32"
         dataset = jnp.asarray(deserialize_mdspan(f))
         graph = jnp.asarray(deserialize_mdspan(f))
     return CagraIndex(dataset=dataset, graph=graph, metric=metric,
-                      seed_pool_hint=hint)
+                      data_kind=kind, seed_pool_hint=hint)

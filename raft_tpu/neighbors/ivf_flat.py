@@ -156,25 +156,6 @@ def _fill_lists(x, ids, labels, n_lists: int, capacity: int):
     return data, idbuf, norms, counts.astype(jnp.int32)
 
 
-def _coerce_queries(data_kind: str, queries):
-    """Move queries into an index's storage domain (shared by the
-    single-chip and distributed searches): integer queries must match the
-    index's dtype and shift with it; float queries against a shifted-uint8
-    index shift by -128 (L2-invariant)."""
-    if data_kind not in ("int8", "uint8"):
-        return queries
-    if queries.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8)):
-        expects(str(queries.dtype) == data_kind,
-                "this index stores %s vectors; got %s queries",
-                data_kind, queries.dtype)
-        from .brute_force import _as_signed
-
-        return _as_signed(queries).astype(jnp.float32)
-    if data_kind == "uint8":
-        return queries.astype(jnp.float32) - 128.0
-    return queries
-
-
 def _resolve_storage(list_dtype: str, x, mt: DistanceType):
     """Resolve the list_dtype policy for a dataset: returns (data_kind,
     storage-domain x, f32 working view). Shared by the single-chip build and
@@ -449,6 +430,7 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
     neighbors/ivf_flat.cuh search_with_filtering). Returns
     (distances (m,k), ids (m,k)); id -1 marks slots beyond the probed
     candidate count."""
+    from .brute_force import _coerce_queries
     from .sample_filter import resolve_filter
 
     res = res or default_resources()

@@ -167,6 +167,14 @@ class IvfPqIndex:
     # True: codes are hi/lo nibble pairs into two 16-entry stage codebooks
     # (codebooks[..., :16, :] and [..., 16:, :]); see IndexParams.pq8_split
     pq_split: bool = False
+    # what the ingested dataset WAS (reference: the ivf_pq int8_t/uint8_t
+    # instantiations, cpp/src/neighbors/ivf_pq_build_*.cu): "float32"
+    # (float data), "int8" (signed bytes), "uint8" (bytes ingested shifted
+    # by -128 into the s8 domain — queries shift the same way at search;
+    # L2 is shift-invariant). The stored representation is PQ codes either
+    # way; data_kind governs what extend() accepts and how search()
+    # coerces queries, so a byte index never silently mixes domains.
+    data_kind: str = "float32"
 
     @property
     def n_lists(self) -> int:
@@ -211,12 +219,35 @@ class IvfPqIndex:
         children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
                     self.list_codes, self.list_ids, self.list_sizes, self.list_consts)
         return children, (self.metric, self.codebook_kind, self.pq_bits,
-                          self.split_factor, self.pq_split)
+                          self.split_factor, self.pq_split, self.data_kind)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        kind = aux[5] if len(aux) > 5 else "float32"
         return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2],
-                   split_factor=aux[3], pq_split=aux[4])
+                   split_factor=aux[3], pq_split=aux[4], data_kind=kind)
+
+
+def _resolve_pq_ingest(x, mt: DistanceType):
+    """int8/uint8 dataset ingestion (reference: the ivf_pq int8_t/uint8_t
+    instantiations, cpp/src/neighbors/ivf_pq_build_*.cu — BigANN-class byte
+    data is PQ's home regime). Returns (data_kind, f32 working view): uint8
+    shifts by -128 into the s8 domain first (L2 is shift-invariant; queries
+    shift the same way at search), and all PQ math — coarse k-means,
+    residuals, codebook training, encoding — runs in f32, where every 8-bit
+    integer is exactly representable. Shared by the single-chip and
+    distributed (parallel/ivf.build_pq) builds so both ingest identically."""
+    int_dtypes = (jnp.dtype(jnp.int8), jnp.dtype(jnp.uint8))
+    if x.dtype not in int_dtypes:
+        return "float32", x
+    # uint8 under IP is NOT shift-invariant and the per-vector sum
+    # correction is not stored (same contract as ivf_flat int8 storage)
+    expects(mt != DistanceType.InnerProduct or x.dtype == jnp.int8,
+            "uint8 + inner_product is unsupported for ivf_pq byte ingestion "
+            "(the -128 shift changes inner products); cast to float32")
+    from .brute_force import _as_signed
+
+    return str(x.dtype), _as_signed(x).astype(jnp.float32)
 
 
 def _default_pq_dim(d: int, pq_bits: int = 4) -> int:
@@ -515,6 +546,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     expects(params.codebook_kind in ("per_subspace", "per_cluster", "auto"),
             "codebook_kind must be per_subspace|per_cluster|auto")
 
+    data_kind, x = _resolve_pq_ingest(x, mt)
     pq_dim = params.pq_dim or _default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
     d_rot = pq_dim * pq_len
@@ -618,10 +650,12 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         pq_bits=params.pq_bits,
         split_factor=params.split_factor,
         pq_split=split,
+        data_kind=data_kind,
     )
     if not params.add_data_on_build:
         return index
-    return extend(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
+    # x is already the f32 working view (byte data was shifted+upcast above)
+    return _extend_f32(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
 
 
 def resolve_scan_impl(params: SearchParams, index: IvfPqIndex, n_codes: int) -> str:
@@ -673,7 +707,25 @@ def _check_split_consts(index: IvfPqIndex) -> None:
 def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None,
            split_factor: float | None = None) -> IvfPqIndex:
     """Encode + append vectors (reference: ivf_pq::extend; encode path
-    process_and_fill_codes, detail/ivf_pq_build.cuh)."""
+    process_and_fill_codes, detail/ivf_pq_build.cuh). Byte indexes
+    (data_kind int8/uint8) take vectors in the index's ORIGINAL dtype —
+    a plain astype would wrap uint8 values mod 256 instead of shifting."""
+    x = jnp.asarray(new_vectors)
+    if index.data_kind in ("int8", "uint8"):
+        expects(str(x.dtype) == index.data_kind,
+                "this index stores %s vectors; got %s", index.data_kind,
+                x.dtype)
+        from .brute_force import _as_signed
+
+        x = _as_signed(x).astype(jnp.float32)
+    return _extend_f32(index, x, new_ids, res=res, split_factor=split_factor)
+
+
+def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
+                res: Resources | None = None,
+                split_factor: float | None = None) -> IvfPqIndex:
+    """extend() after domain conversion: vectors already live in the index's
+    f32 working domain (s8-shifted for uint8 kinds)."""
     res = res or default_resources()
     _check_split_consts(index)
     x = jnp.asarray(new_vectors)
@@ -1111,10 +1163,12 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     user jit returns all-sentinel results (-1 ids, +inf distances) instead
     of raising."""
     from .sample_filter import resolve_filter
+    from .brute_force import _coerce_queries
 
     res = res or default_resources()
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
+    queries = _coerce_queries(index.data_kind, queries)
     expects(index.capacity > 0, "index is empty")
     _check_split_consts(index)
     if not isinstance(index.list_sizes, jax.core.Tracer):
@@ -1179,6 +1233,7 @@ def save(index: IvfPqIndex, path: str) -> None:
         serialize_scalar(f, index.pq_bits)
         serialize_scalar(f, float(index.split_factor))
         serialize_scalar(f, bool(index.pq_split))
+        serialize_scalar(f, index.data_kind)
         for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
                     index.list_codes, index.list_ids, index.list_sizes,
                     index.list_consts):
@@ -1188,12 +1243,18 @@ def save(index: IvfPqIndex, path: str) -> None:
 def load(path: str, res: Resources | None = None) -> IvfPqIndex:
     """Deserialize (reference: ivf_pq_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        check_header(f, "ivf_pq")
+        ver = check_header(f, "ivf_pq")
         metric = DistanceType(deserialize_scalar(f))
         codebook_kind = deserialize_scalar(f)
         pq_bits = deserialize_scalar(f)
         split_factor = float(deserialize_scalar(f))
         pq_split = bool(deserialize_scalar(f))
+        # raft_tpu/6 added data_kind (int8/uint8 byte ingestion); older
+        # files could only hold float data
+        kind = (deserialize_scalar(f)
+                if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5")
+                else "float32")
         arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(8)]
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
-                      split_factor=split_factor, pq_split=pq_split)
+                      split_factor=split_factor, pq_split=pq_split,
+                      data_kind=kind)

@@ -1,0 +1,7 @@
+"""jax-version compatibility aliases shared by the Pallas kernels."""
+
+from jax.experimental.pallas import tpu as pltpu
+
+# pltpu.CompilerParams was named TPUCompilerParams before jax 0.5; the
+# kernels only pass vmem_limit_bytes, which both spellings accept.
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams

@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._compat import CompilerParams as _CompilerParams
+
 __all__ = ["cagra_hop", "hop_backend_ok", "hop_shapes_eligible"]
 
 _POOL = 128               # merge pool lanes: itopk + deg must fit
@@ -56,11 +58,31 @@ def hop_backend_ok():
     return on_tpu or interpret_ok, not on_tpu
 
 
-def hop_shapes_eligible(itopk: int, deg: int, width: int, d: int) -> bool:
+# VMEM budget for the staged candidate-vector block (of the kernel's 100MB
+# vmem_limit_bytes, leaving headroom for the beam-state blocks and scratch)
+_HOP_VMEM_BUDGET = 80 * 1024 * 1024
+
+
+def hop_shapes_eligible(itopk: int, deg: int, width: int, d: int,
+                        itemsize: int = 4) -> bool:
     """The fused hop supports any search_width whose merge pool
-    (itopk + width*degree candidates) fits one 128-lane register row."""
-    return (width >= 1 and itopk + width * deg <= _POOL and itopk >= 1
-            and d <= 4096)
+    (itopk + width*degree candidates) fits one 128-lane register row AND
+    whose staged d-scaled blocks fit the VMEM budget: the kernel stages a
+    (qt=128, width*deg, d_pad) candidate block of the dataset's dtype
+    (``itemsize`` bytes/element — 1 for byte datasets, which are upcast
+    in-kernel) plus a (qt, d_pad) f32 query tile, both double-buffered by
+    the Pallas pipeline. Bounding by
+    estimated bytes instead of a flat ``d <= 4096`` cap means
+    ``hop_impl='auto'`` falls back to the XLA loop for large-d configs
+    (e.g. itopk=32, deg=32, d=4096 f32: ~67MB/block, >100MB double-buffered)
+    instead of failing at compile (ADVICE r5)."""
+    if not (width >= 1 and itopk + width * deg <= _POOL and itopk >= 1
+            and d >= 1):
+        return False
+    d_pad = -(-d // 128) * 128
+    vec_bytes = 128 * width * deg * d_pad * itemsize
+    q_bytes = 128 * d_pad * 4  # f32 query tile, also double-buffered
+    return 2 * (vec_bytes + q_bytes) <= _HOP_VMEM_BUDGET
 
 
 def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
@@ -88,7 +110,11 @@ def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
             nd = jnp.abs(nbr).astype(jnp.float32)  # fake but well-formed
         else:
             q = q_ref[...]                   # (qt, dp)
-            vecs = vec_ref[...]              # (qt, cw, dp)
+            # byte datasets arrive as int8 (a quarter of the f32 DMA bytes
+            # — the hop's vector traffic) and upcast HERE, at the tile
+            # level; 8-bit integers are exact in f32, so the s8 path's
+            # distances match the f32 path's bitwise
+            vecs = vec_ref[...].astype(jnp.float32)  # (qt, cw, dp)
             diff = vecs - q[:, None, :]
             nd = jnp.sum(diff * diff, axis=-1)   # (qt, cw)
         # valid is per-candidate (the XLA side expands the per-pick flags
@@ -234,7 +260,9 @@ def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
     ``queries`` (m, d) f32; ``beam_d/beam_i/beam_v`` (m, 128) padded beam
     state (distances f32 ascending, ids i32, visited i32; lanes >= itopk are
     +inf/-1/1); ``nbrs`` (m, cw) i32 candidate ids for cw = width*degree
-    (-1 = none); ``vecs`` (m, cw, d) their vectors; ``valid`` (m, cw) i32 —
+    (-1 = none); ``vecs`` (m, cw, d) their vectors — f32, or int8 for byte
+    datasets (upcast in-kernel at the tile level: quarter the DMA bytes,
+    bitwise-identical distances); ``valid`` (m, cw) i32 —
     0 masks a candidate (the caller expands each pick's validity over its
     deg candidates; all-zero primes the loop).
 
@@ -282,7 +310,7 @@ def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
             pltpu.VMEM((qt, _POOL), jnp.int32),     # merge pool visited
             pltpu.SMEM((1,), jnp.int32),            # arena insertion gate
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(*args)

@@ -74,6 +74,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._compat import CompilerParams as _CompilerParams
+
 __all__ = ["fused_knn", "FUSED_KNN_MAX_K"]
 
 FUSED_KNN_MAX_K = 64          # merge buffer is one 128-lane register: 2k <= 128
@@ -256,7 +258,7 @@ def _fused_knn_impl(dataset, queries, yn, keep, k, l2, mode, qt, nblk,
             pltpu.VMEM((qt, 128), jnp.int32),       # block candidates (ids)
             pltpu.SMEM((1,), jnp.int32),            # extraction gate
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(qs, ds, ynp)

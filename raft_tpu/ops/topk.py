@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._compat import CompilerParams as _CompilerParams
+
 __all__ = ["topk_pallas", "TOPK_MAX_K"]
 
 # k <= 64: merge buffer is one 128-lane register (measured path).
@@ -178,6 +180,13 @@ def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
     values are exact either way, restored by the final gather). Pre-scale
     inputs if distinctions above 2.9e38 matter; distance pipelines never get
     near this range.
+
+    ONE-INSTANCE-PER-PROGRAM LIMIT for k > 128: embedding two kh=256 kernel
+    instances (two k > 128 calls) inside one XLA program hits a TPU-internal
+    Mosaic error — standalone calls are fine, and the matrix/select_k.py
+    dispatch therefore never routes k > 128 here (it can be embedded
+    anywhere). If you call topk_pallas directly with k > 128, keep each call
+    in its own jit program, or use lax.top_k for the second selection.
     """
     m, n = x.shape
     if k > min(TOPK_MAX_K, n):
@@ -213,7 +222,7 @@ def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
             pltpu.VMEM((qt, w), jnp.int32),
             pltpu.SMEM((2,), jnp.int32),            # extraction + merge gates
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(x)

@@ -43,9 +43,11 @@ class ShardedCagraIndex:
     """Stacked per-shard CAGRA indexes: shard s owns dataset rows
     [s*rows_per_shard, (s+1)*rows_per_shard) of the original ordering."""
 
-    dataset: jax.Array   # (S, n/S, d)
+    dataset: jax.Array   # (S, n/S, d) — f32, or int8 for byte datasets
     graph: jax.Array     # (S, n/S, graph_degree) int32, shard-local ids
     metric: DistanceType = DistanceType.L2Expanded
+    # "float32" | "int8" | "uint8" — same contract as CagraIndex.data_kind
+    data_kind: str = "float32"
 
     @property
     def n_shards(self) -> int:
@@ -60,11 +62,12 @@ class ShardedCagraIndex:
         return self.dataset.shape[2]
 
     def tree_flatten(self):
-        return (self.dataset, self.graph), (self.metric,)
+        return (self.dataset, self.graph), (self.metric, self.data_kind)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0])
+        kind = aux[1] if len(aux) > 1 else "float32"
+        return cls(*children, metric=aux[0], data_kind=kind)
 
 
 def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
@@ -83,6 +86,7 @@ def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
         dataset=jnp.stack([s.dataset for s in shards]),
         graph=jnp.stack([s.graph for s in shards]),
         metric=shards[0].metric,
+        data_kind=shards[0].data_kind,
     )
 
 
@@ -93,9 +97,12 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
     Returns replicated (distances (m, k), global ids (m, k)); ids refer to
     the original (pre-sharding) dataset row ordering.
     """
+    from ..neighbors.brute_force import _coerce_queries
+
     queries = jnp.asarray(queries)
     expects(queries.ndim == 2 and queries.shape[1] == index.dim, "query dim mismatch")
     expects(k <= params.itopk_size, "k must be <= itopk_size")
+    queries = _coerce_queries(index.data_kind, queries)
     size = comms.size()
     expects(index.n_shards == size, "index has %d shards but mesh axis is %d",
             index.n_shards, size)
@@ -110,7 +117,8 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
     # Per-shard indexes carry no seed_pool_hint; auto falls to the default.
     seed_pool = resolve_seed_pool(params)  # _cagra_search clamps to shard rows
     hop_impl = resolve_hop_impl(
-        params, index.graph.shape[-1], index.dim)
+        params, index.graph.shape[-1], index.dim,
+        itemsize=index.dataset.dtype.itemsize)
 
     mesh, axis = comms.mesh, comms.axis
     args = (

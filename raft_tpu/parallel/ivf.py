@@ -125,7 +125,7 @@ def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: 
 
     Returns replicated (distances (m, k), global ids (m, k)).
     """
-    from ..neighbors.ivf_flat import _coerce_queries
+    from ..neighbors.brute_force import _coerce_queries
 
     queries = _coerce_queries(index.data_kind, jnp.asarray(queries))
     size = comms.size()
@@ -194,6 +194,7 @@ def _pad_pq_lists(index, size: int):
         pq_bits=index.pq_bits,
         split_factor=index.split_factor,
         pq_split=index.pq_split,
+        data_kind=index.data_kind,
     )
 
 
@@ -216,8 +217,10 @@ def search_pq(comms: Comms, params, index, queries, k: int,
                                          pq_scan_bytes_per_probe_row)
     from ..neighbors.ivf_pq import IvfPqIndex, _pq_search
 
+    from ..neighbors.brute_force import _coerce_queries
+
     res = res or default_resources()
-    queries = jnp.asarray(queries)
+    queries = _coerce_queries(index.data_kind, jnp.asarray(queries))
     size = comms.size()
     index = _pad_pq_lists(index, size)
     L = index.n_lists
@@ -613,6 +616,9 @@ def build_pq(comms: Comms, params, dataset, res=None):
     L = params.n_lists
     expects(L % S == 0, "n_lists (%d) must divide the mesh axis (%d)", L, S)
     mt = resolve_metric(params.metric)
+    # int8/uint8 ingestion, identical to the single-chip build (shift into
+    # the s8 domain, work in the exact f32 image)
+    data_kind, x = pq_mod._resolve_pq_ingest(x, mt)
     expects(params.codebook_kind in ("auto", "per_subspace"),
             "the distributed build trains per-subspace codebooks "
             "(codebook_kind=%r is single-chip only)", params.codebook_kind)
@@ -700,4 +706,5 @@ def build_pq(comms: Comms, params, dataset, res=None):
         codebooks=codebooks, list_codes=codes_arr, list_ids=idb,
         list_sizes=gcounts, list_consts=cbuf, metric=mt,
         codebook_kind="per_subspace", pq_bits=params.pq_bits,
-        split_factor=params.split_factor, pq_split=split)
+        split_factor=params.split_factor, pq_split=split,
+        data_kind=data_kind)

@@ -86,3 +86,53 @@ def test_row_guard_success_and_error_paths():
     bench._row_guard(rows, "err_row", boom, timeout_s=5)
     assert rows[0]["name"] == "err_row"
     assert rows[0]["error"].startswith("ValueError")
+
+
+def test_flagship_i8_row_smoke(monkeypatch):
+    """The driver-bench i8 rows (this PR's acceptance measurement) must
+    produce qps+recall rows, not guarded error rows, when the kernels run —
+    a NameError in the row body would silently erase the headline number on
+    the TPU driver run. Shrunk shapes, interpret-mode kernels; the shape
+    arguments exist on the row functions exactly for this smoke."""
+    monkeypatch.setenv("RAFT_TPU_FUSED_KNN_INTERPRET", "1")
+    import bench
+
+    rows = []
+    bench._flagship_exact(rows, n=4500, d=72, m=150, k=10, n_batches=2)
+    by = {r["name"]: r for r in rows if "name" in r}
+    assert "exact_fused_knn_100k" in by, rows
+    row = by.get("exact_fused_knn_100k_i8")
+    assert row is not None and "error" not in row, rows
+    # uniform [0,1) quantized onto 1/255 bins: neighbor margins at this
+    # scale dwarf the quantization noise
+    assert row["recall"] > 0.8, row
+    assert row["i8_over_f32"] > 0, row
+    assert by["exact_xla_control"]["fused_over_control"] > 0, by
+
+
+def test_ivf_pq_i8_row_smoke(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FUSED_KNN_INTERPRET", "1")
+    import numpy as np
+
+    import bench
+
+    rng = np.random.default_rng(3)
+    centers = rng.random((32, 64)).astype(np.float32) * 10.0
+    lab = rng.integers(0, 32, 6000)
+    dataset = (centers[lab]
+               + 0.3 * rng.standard_normal((6000, 64))).astype(np.float32)
+    qsets = []
+    for _ in range(3):
+        qlab = rng.integers(0, 32, 200)
+        qsets.append((centers[qlab] + 0.3 * rng.standard_normal(
+            (200, 64))).astype(np.float32))
+    import jax.numpy as jnp
+
+    rows = []
+    bench._row_ivf_pq_i8(rows, jnp.asarray(dataset),
+                         [jnp.asarray(q) for q in qsets],
+                         n_lists=32, pq_dim=32)
+    row = rows[-1]
+    assert row["name"] == "ivf_pq_1m_i8" and "error" not in row, rows
+    assert row["recall"] > 0.7, row
+    assert row["i8_over_f32"] is None  # no f32 LID row in this smoke

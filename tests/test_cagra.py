@@ -321,3 +321,128 @@ class TestBuildProbesAuto:
         true_i = np.argsort(sp_dist.cdist(x[:200], x, "sqeuclidean"), 1)[:, 1:17]
         rec = _recall(g[:200], true_i)
         assert rec > 0.8, rec
+
+
+class TestByteDatasets:
+    """int8/uint8 datasets end-to-end (reference: the dtype-generic
+    cagra::index<T> int8_t/uint8_t instantiations). The index stores native
+    bytes — uint8 shifted by -128 into the s8 domain, L2-invariant — and the
+    hop paths upcast to f32 at the tile level, where every 8-bit integer is
+    exact, so byte results are checked against the f64 image of the same
+    bytes rather than a loosened threshold."""
+
+    @pytest.fixture(scope="class")
+    def idata(self):
+        # uniform bytes (see the module fixture's note on blobs vs graphs)
+        rng = np.random.default_rng(11)
+        xu = rng.integers(0, 256, (3000, 24), dtype=np.uint8)
+        qu = rng.integers(0, 256, (50, 24), dtype=np.uint8)
+        return xu, qu
+
+    @pytest.fixture(scope="class")
+    def u8_index(self, idata):
+        xu, _ = idata
+        return cagra.build(cagra.IndexParams(
+            intermediate_graph_degree=48, graph_degree=24, seed=0), xu)
+
+    def test_native_byte_storage(self, u8_index):
+        import jax.numpy as jnp
+
+        assert u8_index.data_kind == "uint8"
+        assert u8_index.dataset.dtype == jnp.int8  # shifted s8 bytes
+
+    def test_recall_and_exact_distances(self, u8_index, idata):
+        xu, qu = idata
+        d, i = cagra.search(cagra.SearchParams(itopk_size=64), u8_index, qu, k=10)
+        d2 = ((qu[:, None, :].astype(np.float64)
+               - xu[None].astype(np.float64)) ** 2).sum(-1)
+        true_i = np.argsort(d2, 1)[:, :10]
+        rec = _recall(np.asarray(i), true_i)
+        assert rec > 0.9, rec
+        # the -128 shift is L2-invariant and 8-bit values are exact in f32:
+        # reported distances are the true integer byte-domain distances
+        got = np.take_along_axis(d2, np.asarray(i), 1)
+        np.testing.assert_allclose(np.asarray(d), got, rtol=1e-6)
+
+    def test_int8_matches_uint8_shifted(self, u8_index, idata):
+        """uint8 ingestion = the pre-shifted int8 build, bit for bit."""
+        xu, qu = idata
+        xs = (xu.astype(np.int16) - 128).astype(np.int8)
+        qs = (qu.astype(np.int16) - 128).astype(np.int8)
+        idx = cagra.build(cagra.IndexParams(
+            intermediate_graph_degree=48, graph_degree=24, seed=0), xs)
+        assert idx.data_kind == "int8"
+        np.testing.assert_array_equal(np.asarray(idx.graph),
+                                      np.asarray(u8_index.graph))
+        _, i_s = cagra.search(cagra.SearchParams(itopk_size=64), idx, qs, k=10)
+        _, i_u = cagra.search(cagra.SearchParams(itopk_size=64), u8_index, qu, k=10)
+        np.testing.assert_array_equal(np.asarray(i_s), np.asarray(i_u))
+
+    def test_float_queries_on_uint8_index(self, u8_index, idata):
+        _, qu = idata
+        _, i_b = cagra.search(cagra.SearchParams(itopk_size=64), u8_index, qu, k=10)
+        _, i_f = cagra.search(cagra.SearchParams(itopk_size=64), u8_index,
+                              qu.astype(np.float32), k=10)
+        np.testing.assert_array_equal(np.asarray(i_b), np.asarray(i_f))
+
+    def test_query_dtype_guard(self, u8_index, idata):
+        from raft_tpu.core import RaftError
+
+        _, qu = idata
+        qs = (qu.astype(np.int16) - 128).astype(np.int8)
+        with pytest.raises(RaftError, match="stores uint8"):
+            cagra.search(cagra.SearchParams(itopk_size=64), u8_index, qs, k=10)
+
+    def test_fused_hop_matches_xla_on_bytes(self, u8_index, idata, monkeypatch):
+        """The Pallas hop takes int8 candidate blocks (quarter the DMA
+        bytes) and upcasts in-kernel — must track the XLA loop."""
+        monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
+        _, qu = idata
+        d_x, i_x = cagra.search(cagra.SearchParams(
+            itopk_size=32, hop_impl="xla"), u8_index, qu, k=10)
+        d_f, i_f = cagra.search(cagra.SearchParams(
+            itopk_size=32, hop_impl="fused_arena"), u8_index, qu, k=10)
+        i_x, i_f = np.asarray(i_x), np.asarray(i_f)
+        overlap = np.mean([len(set(i_x[r]) & set(i_f[r])) / 10
+                           for r in range(i_x.shape[0])])
+        assert overlap > 0.95, overlap
+        np.testing.assert_allclose(np.sort(np.asarray(d_f), 1),
+                                   np.sort(np.asarray(d_x), 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_roundtrip_preserves_bytes(self, tmp_path, u8_index, idata):
+        import jax.numpy as jnp
+
+        _, qu = idata
+        p = str(tmp_path / "cagra_u8.bin")
+        cagra.save(u8_index, p)
+        idx2 = cagra.load(p)
+        assert idx2.data_kind == "uint8"
+        assert idx2.dataset.dtype == jnp.int8
+        d1, i1 = cagra.search(cagra.SearchParams(itopk_size=32), u8_index, qu, k=5)
+        d2, i2 = cagra.search(cagra.SearchParams(itopk_size=32), idx2, qu, k=5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+
+def test_seed_pool_log_reports_calibrated_threshold(caplog):
+    """The seed_pool autotune logs must interpolate the threshold constant
+    actually applied (_SEED_JUMP_RATIO = 2.0), not a stale literal
+    (ADVICE r5: the success log said "jump >=4x" while the rule was 2.0)."""
+    import logging
+
+    rng = np.random.default_rng(0)
+    # >= 4096 rows and >= 8 graph columns: the autotune's lower bound —
+    # smaller inputs return the default pool without logging
+    x = rng.random((4200, 16)).astype(np.float32)
+    params = cagra.IndexParams(intermediate_graph_degree=16, graph_degree=8,
+                               build_chunk=2100, seed=0)
+    g = cagra.build_knn_graph(params, np.asarray(x))
+    with caplog.at_level(logging.INFO, logger="raft_tpu"):
+        cagra.estimate_seed_pool(x, g, seed=0)
+    msgs = [r.getMessage() for r in caplog.records
+            if "seed_pool auto" in r.getMessage()]
+    assert msgs, "autotune logged nothing"
+    want = ">=%.0fx" % cagra._SEED_JUMP_RATIO
+    assert all("4x" not in m or want == ">=4x" for m in msgs), msgs
+    assert any(want in m for m in msgs), (want, msgs)

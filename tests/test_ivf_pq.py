@@ -530,3 +530,102 @@ class TestGroupedScan:
         with pytest.raises(RaftError, match="capacity"):
             ivf_pq.search(ivf_pq.SearchParams(
                 n_probes=32, scan_order="grouped"), idx, q, idx.capacity + 1)
+
+
+class TestByteDatasets:
+    """int8/uint8 dataset ingestion end-to-end (reference: the dedicated
+    ivf_pq int8_t/uint8_t instantiations, cpp/src/neighbors/ivf_pq_build_*
+    — BigANN-class byte data is PQ's home regime). All PQ math runs on the
+    exact f32 image of the bytes (uint8 shifted by -128, L2-invariant), so
+    recall bars match the float tests'."""
+
+    @pytest.fixture(scope="class")
+    def idata(self):
+        rng = np.random.default_rng(5)
+        # clustered bytes: blob centers + noise, clipped to [0, 255]
+        centers = rng.integers(40, 215, (24, 32))
+        lab = rng.integers(0, 24, 4000)
+        x = np.clip(centers[lab] + rng.normal(0, 12, (4000, 32)), 0, 255)
+        qlab = rng.integers(0, 24, 60)
+        q = np.clip(centers[qlab] + rng.normal(0, 12, (60, 32)), 0, 255)
+        return x.astype(np.uint8), q.astype(np.uint8)
+
+    @pytest.mark.parametrize("dt", [np.uint8, np.int8])
+    def test_build_search_recall(self, idata, dt):
+        xu, qu = idata
+        x = xu if dt == np.uint8 else (xu.astype(np.int16) - 128).astype(np.int8)
+        q = qu if dt == np.uint8 else (qu.astype(np.int16) - 128).astype(np.int8)
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=32, seed=0), x)
+        assert idx.data_kind == dt.__name__
+        _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), idx, q, k=10)
+        d2 = ((q[:, None, :].astype(np.float64)
+               - x[None].astype(np.float64)) ** 2).sum(-1)
+        true_i = np.argsort(d2, 1)[:, :10]
+        rec = _recall(np.asarray(i), true_i)
+        assert rec > 0.8, rec  # PQ-lossy exact-probe recall, float parity
+
+    def test_signed_and_shifted_agree(self, idata):
+        """uint8 ingestion = the pre-shifted int8 build, identical ids."""
+        xu, qu = idata
+        xs = (xu.astype(np.int16) - 128).astype(np.int8)
+        qs = (qu.astype(np.int16) - 128).astype(np.int8)
+        ip = ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0)
+        _, i_u = ivf_pq.search(ivf_pq.SearchParams(n_probes=16),
+                               ivf_pq.build(ip, xu), qu, 10)
+        _, i_s = ivf_pq.search(ivf_pq.SearchParams(n_probes=16),
+                               ivf_pq.build(ip, xs), qs, 10)
+        np.testing.assert_array_equal(np.asarray(i_u), np.asarray(i_s))
+
+    def test_refine_pipeline(self, idata):
+        """Byte PQ search k0 > k feeding an exact byte refine — the
+        reference's standard BigANN operating point."""
+        xu, qu = idata
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=32, pq_dim=32, seed=0), xu)
+        _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), idx, qu, k=40)
+        _, i = refine(xu, qu, np.asarray(cand), k=10)
+        d2 = ((qu[:, None, :].astype(np.float64)
+               - xu[None].astype(np.float64)) ** 2).sum(-1)
+        true_i = np.argsort(d2, 1)[:, :10]
+        rec = _recall(np.asarray(i), true_i)
+        assert rec > 0.9, rec
+
+    def test_float_queries_on_uint8_index(self, idata):
+        xu, qu = idata
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0), xu)
+        _, i_b = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx, qu, 10)
+        _, i_f = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx,
+                               qu.astype(np.float32), 10)
+        np.testing.assert_array_equal(np.asarray(i_b), np.asarray(i_f))
+
+    def test_extend_dtype_guard(self, idata):
+        from raft_tpu.core import RaftError
+
+        xu, _ = idata
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0),
+                           xu[:3000])
+        # a plain astype would wrap the domain mod 256 — must be rejected
+        with pytest.raises(RaftError, match="stores uint8"):
+            ivf_pq.extend(idx, (xu[3000:].astype(np.int16) - 128).astype(np.int8))
+        idx2 = ivf_pq.extend(idx, xu[3000:])
+        assert int(np.asarray(idx2.list_sizes).sum()) == len(xu)
+        assert idx2.data_kind == "uint8"
+
+    def test_uint8_inner_product_rejected(self, idata):
+        from raft_tpu.core import RaftError
+
+        xu, _ = idata
+        with pytest.raises(RaftError, match="inner_product"):
+            ivf_pq.build(ivf_pq.IndexParams(
+                n_lists=16, pq_dim=8, metric="inner_product", seed=0), xu)
+
+    def test_roundtrip_preserves_kind(self, tmp_path, idata):
+        xu, qu = idata
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=8, seed=0), xu)
+        p = str(tmp_path / "pq_u8.bin")
+        ivf_pq.save(idx, p)
+        idx2 = ivf_pq.load(p)
+        assert idx2.data_kind == "uint8"
+        d1, i1 = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), idx, qu, 5)
+        d2, i2 = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), idx2, qu, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-6)

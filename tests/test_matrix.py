@@ -46,6 +46,30 @@ class TestSelectK:
         with pytest.raises(RaftError):
             matrix.select_k(np.zeros((2, 4)), 0)
 
+    @pytest.mark.parametrize("dt", [np.int32, np.int8, np.uint8, np.uint32])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_integer_values_exact(self, rng, dt, select_min):
+        """Integer scores (exact int32 distances from the s8 search paths,
+        byte payload matrices) rank exactly — unsigned flips about the
+        dtype max, signed sub-32-bit widens before negation — and keep
+        their dtype and magnitudes in the output values."""
+        info = np.iinfo(dt)
+        # full-range draws so the wrap hazards (negation at INT_MIN, the
+        # uint flip) are actually on the board — and both extremes pinned
+        # deterministically (a random draw almost never lands INT32_MIN)
+        v = rng.integers(info.min, int(info.max) + 1, (9, 40)).astype(dt)
+        v[0, 3], v[0, 7] = info.min, info.max
+        vals, idx = matrix.select_k(v, 7, select_min=select_min)
+        assert np.asarray(vals).dtype == dt
+        sv = np.sort(v.astype(np.int64), axis=1)
+        want = sv[:, :7] if select_min else sv[:, ::-1][:, :7]
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(vals).astype(np.int64), axis=1),
+            np.sort(want, axis=1))
+        # indices must address the selected values
+        np.testing.assert_array_equal(
+            np.take_along_axis(v, np.asarray(idx), 1), np.asarray(vals))
+
 
 class TestOps:
     def test_argmax_argmin(self, rng):

@@ -116,3 +116,90 @@ print('CACHE_OK', len(entries))
     assert r2.returncode == 0, r2.stdout + r2.stderr
     n_entries2 = int(r2.stdout.split("CACHE_OK")[1].split()[0])
     assert n_entries2 == n_entries, (n_entries, n_entries2)
+
+
+def test_warmup_warms_the_callers_k(tmp_path):
+    """Regression (ADVICE r5 medium): the ivf_pq warmup used to search at
+    ``max(k, 40)`` instead of the caller's k — the production k=10 program
+    still compiled cold. The warmed search must be the SAME jitted program
+    as the production search at those shapes: a production search after
+    warmup adds ZERO new trace-cache entries to the k-carrying search jits.
+    Runs in a subprocess because warmup permanently redirects the process's
+    jax compilation-cache config."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    cache = tmp_path / "warmkcache"
+    code = f"""
+import sys
+sys.path.insert(0, {str(repo)!r})
+from raft_tpu.core.platform import force_virtual_cpu
+force_virtual_cpu(1)
+import jax, jax.numpy as jnp
+import raft_tpu
+from raft_tpu.neighbors import ivf_pq
+
+ip = ivf_pq.IndexParams(n_lists=16, seed=0)
+sp = ivf_pq.SearchParams(n_probes=4)
+out = raft_tpu.warmup("ivf_pq", n=2000, d=16, k=7, queries=64,
+                      index_params=ip, search_params=sp,
+                      cache_dir={str(cache)!r})
+assert out["search_s"] > 0, out
+
+# production pipeline at the same shapes: identical data generation
+# (warmup's own protocol, seed=0) so the built index has identical avals
+kd, kq = jax.random.split(jax.random.key(0))
+x = jax.random.uniform(kd, (2000, 16), jnp.float32)
+q = jax.random.uniform(kq, (64, 16), jnp.float32)
+idx = ivf_pq.build(ip, x)
+before = (ivf_pq._pq_search._cache_size(),
+          ivf_pq._pq_search_grouped._cache_size())
+ivf_pq.search(sp, idx, q, 7)
+after = (ivf_pq._pq_search._cache_size(),
+         ivf_pq._pq_search_grouped._cache_size())
+assert after == before, ("production k=7 search re-traced after a k=7 "
+                         "warmup", before, after)
+print("WARMK_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=360)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WARMK_OK" in r.stdout
+
+
+def test_warmup_byte_dtype(tmp_path):
+    """``warmup(..., dtype="uint8")`` must run the byte-dataset pipeline:
+    random bytes in the target dtype so the s8 kernels and int8 list
+    layouts compile exactly as production will run them."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    cache = tmp_path / "warmu8cache"
+    code = f"""
+import sys
+sys.path.insert(0, {str(repo)!r})
+from raft_tpu.core.platform import force_virtual_cpu
+force_virtual_cpu(1)
+import raft_tpu
+from raft_tpu.neighbors import ivf_flat
+out = raft_tpu.warmup("ivf_flat", n=2000, d=16, queries=64, dtype="uint8",
+                      index_params=ivf_flat.IndexParams(n_lists=16, seed=0),
+                      cache_dir={str(cache)!r})
+assert out["build_s"] > 0 and out["search_s"] > 0, out
+print("WARMU8_OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=360)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "WARMU8_OK" in r.stdout
+
+    # the dtype guard needs no jax work and is safe in-process
+    import raft_tpu
+    from raft_tpu.core import RaftError
+
+    with pytest.raises(RaftError, match="dtype must be"):
+        raft_tpu.warmup("ivf_flat", n=100, d=8, dtype="float16")
