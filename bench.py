@@ -38,6 +38,12 @@ archives per round:
   i8_over_f32 on the i8 rows) so BASELINE round notes can be regenerated
   from the JSON artifact alone (VERDICT item 7).
 
+  Each guarded row scope also attaches an "obs" attribution dict
+  (compile_s, cache_hits/misses, collective_bytes — from raft_tpu.obs via
+  jax.monitoring) so the artifact says WHERE the seconds went, not just the
+  QPS; `--no-metrics` disables the whole obs surface (rows then carry no
+  "obs" field) and proves the disabled path the obs_overhead test guards.
+
 Measurement notes:
 - batches are chained inside ONE jitted program with DISTINCT query data and
   materialized to host: the device tunnel caches repeated identical dispatches
@@ -63,7 +69,7 @@ import time
 SOFT_BUDGET_S = 480.0  # stop starting new rows beyond this
 _T0 = time.perf_counter()
 
-_STATE = {"primary": 0.0, "fused_ok": True, "rows": []}
+_STATE = {"primary": 0.0, "fused_ok": True, "rows": [], "metrics": True}
 
 
 def _elapsed():
@@ -88,8 +94,56 @@ def _emit():
                         if _STATE["fused_ok"] and _STATE["primary"] > 0
                         else None),
         "rows": _STATE["rows"],
+        "metrics_enabled": _STATE["metrics"],
         "elapsed_s": round(_elapsed(), 1),
     }), flush=True)
+
+
+def _obs_snap():
+    """Flat obs snapshot, or None when metrics are disabled/unavailable —
+    never fatal (the bench must survive a broken raft_tpu import)."""
+    try:
+        from raft_tpu import obs
+
+        if not obs.enabled():
+            return None
+        return obs.to_json()
+    except Exception:
+        return None
+
+
+def _obs_attach(rows, start, before):
+    """Attach the compile/cache/collective attribution of one guarded row
+    scope to every row it appended (ISSUE 2: BENCH artifacts carry the
+    attribution alongside QPS). Rows produced by the same scope share the
+    scope's delta; under --no-metrics no "obs" field appears at all (the
+    disabled-path proof)."""
+    if before is None:
+        return
+    after = _obs_snap()
+    if after is None:
+        return
+    try:
+        from raft_tpu import obs
+
+        d = obs.delta(before, after)
+
+        def tot(prefix):
+            return sum(v for k, v in d.items() if k.startswith(prefix))
+
+        summary = {
+            "compile_s": round(
+                tot('raft_tpu_compile_seconds_sum{stage="compile"}'), 3),
+            "cache_hits": int(tot(
+                'raft_tpu_compile_cache_total{outcome="hit"}')),
+            "cache_misses": int(tot(
+                'raft_tpu_compile_cache_total{outcome="miss"}')),
+            "collective_bytes": int(tot("raft_tpu_collective_bytes_total")),
+        }
+        for r in rows[start:]:
+            r.setdefault("obs", summary)
+    except Exception:
+        pass
 
 
 def _recall(ids, gt):
@@ -550,6 +604,8 @@ def _row_guard(rows, name, fn, timeout_s=None, _exit=None):
     if timeout_s is None:
         timeout_s = max(60.0, SOFT_BUDGET_S + 180.0 - _elapsed())
     box = {}
+    start = len(rows)
+    obs_before = _obs_snap()
 
     def body():
         try:
@@ -560,6 +616,11 @@ def _row_guard(rows, name, fn, timeout_s=None, _exit=None):
     t = threading.Thread(target=body, daemon=True)
     t.start()
     t.join(timeout_s)
+    if not t.is_alive():
+        # attribution attaches only on completed scopes; the hang path below
+        # exits the process, so a timed-out row's zombie thread can never
+        # pollute a later row's delta
+        _obs_attach(rows, start, obs_before)
     if t.is_alive():
         # don't shadow a success row the body already emitted under this
         # name (e.g. the flagship primary row printed before a later mode
@@ -588,6 +649,16 @@ def _run(rows):
         enable_compilation_cache()
     except Exception as e:  # cache is an optimization, never fatal
         rows.append({"name": "compilation_cache", "error": str(e)[:200]})
+
+    if _STATE["metrics"]:
+        try:
+            # subscribe to jax.monitoring BEFORE the first compile so every
+            # row's obs delta carries compile_s + cache outcomes
+            from raft_tpu.obs import compile as _obs_compile
+
+            _obs_compile.install()
+        except Exception as e:  # observability is never fatal either
+            rows.append({"name": "obs_install", "error": str(e)[:200]})
 
     _backend_or_exit(rows)
     import jax
@@ -634,10 +705,21 @@ def _run(rows):
             rows, box["dataset"], box["qsets"], box["gt"]))
 
 
-def main():
+def main(argv=None):
     import signal
 
     rows = _STATE["rows"]
+    argv = sys.argv[1:] if argv is None else argv
+    if "--no-metrics" in argv:
+        # the disabled-path proof: every obs touch point reduces to one
+        # module-flag check and rows carry no "obs" attribution field
+        _STATE["metrics"] = False
+        try:
+            from raft_tpu import obs
+
+            obs.disable()
+        except Exception:
+            pass
 
     def _on_term(signum, frame):  # driver SIGTERM -> the emit path below
         raise SystemExit(f"signal {signum}")

@@ -23,6 +23,7 @@ Subpackages (lazily imported):
   label      label utilities                                   (ref: raft/label)
   spatial    legacy spatial::knn aliases + haversine           (ref: raft/spatial)
   config     global output-type conversion                     (ref: pylibraft.config)
+  obs        metrics registry + compile attribution            (ref: nvtx/spdlog/bench harness)
   ops        Pallas TPU kernels backing the hot paths
   parallel   distributed (sharded) algorithm drivers           (ref: raft::comms consumers)
 """
@@ -46,6 +47,7 @@ _SUBMODULES = {
     "solver",
     "spectral",
     "label",
+    "obs",
     "ops",
     "parallel",
     "spatial",

@@ -45,7 +45,8 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
     Enables the persistent compilation cache (``cache_dir`` or the default
     ``~/.cache/raft_tpu/jit``), builds the index on uniform random data of
     the target shape, runs one search of the target batch shape, and returns
-    ``{"build_s": ..., "search_s": ..., "cache_dir": ...}``. Pass the same
+    headline walls (``build_s``/``search_s``/``cache_dir``) plus per-phase
+    compile attribution (see below). Pass the same
     ``index_params``/``search_params`` you will use in production — the
     cache keys on static config (n_lists, pq_dim, itopk, ...), so a warmup
     with different params warms different programs. The same holds for
@@ -57,12 +58,25 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
     ``dtype`` ("float32" | "int8" | "uint8") warms the byte-dataset search
     paths: random data is drawn in the target dtype, so the s8 kernels and
     byte list layouts compile exactly as production will run them.
+
+    The returned dict attributes each wall time instead of leaving it opaque
+    (obs/compile.py, the jax.monitoring subscription): ``build``/``search``
+    each carry ``{wall_s, compile_s, trace_s, programs, cache_hits,
+    cache_misses, program_compile_s}`` — ``program_compile_s`` is the
+    per-program backend-compile seconds, and the cache counters say whether
+    this warmup paid cold compiles or found the cache already hot. On a jax
+    without the monitoring bus the split falls back to a cold-vs-warm wall
+    delta for the search (``attribution: "timing"``) and the per-program
+    detail is empty. A summary INFO line goes through the raft_tpu logger
+    (``core.logger.basic_config`` formats it to stderr in one call).
     """
     import jax
     import jax.numpy as jnp
 
     from .config import enable_compilation_cache
     from .core.errors import expects
+    from .core.logger import logger
+    from .obs import compile as obs_compile
 
     expects(kind in _KINDS, "unknown index kind %r (one of %s)", kind,
             ", ".join(_KINDS))
@@ -80,45 +94,82 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
     jax.block_until_ready((x, q))
 
     t0 = time.perf_counter()
-    if kind == "brute_force":
-        from .neighbors import brute_force
+    with obs_compile.attribution() as build_attr:
+        if kind == "brute_force":
+            from .neighbors import brute_force
 
-        idx = brute_force.BruteForce().build(x)
-        searcher = lambda: idx.search(q, k)
-    elif kind == "ivf_flat":
-        from .neighbors import ivf_flat
+            idx = brute_force.BruteForce().build(x)
+            searcher = lambda: idx.search(q, k)
+        elif kind == "ivf_flat":
+            from .neighbors import ivf_flat
 
-        idx = ivf_flat.build(
-            index_params or ivf_flat.IndexParams(n_lists=1024, seed=seed), x)
-        jax.block_until_ready(idx.list_data)
-        searcher = lambda: ivf_flat.search(
-            search_params or ivf_flat.SearchParams(n_probes=8), idx, q, k)
-    elif kind == "ivf_pq":
-        from .neighbors import ivf_pq
+            idx = ivf_flat.build(
+                index_params or ivf_flat.IndexParams(n_lists=1024, seed=seed), x)
+            jax.block_until_ready(idx.list_data)
+            searcher = lambda: ivf_flat.search(
+                search_params or ivf_flat.SearchParams(n_probes=8), idx, q, k)
+        elif kind == "ivf_pq":
+            from .neighbors import ivf_pq
 
-        idx = ivf_pq.build(
-            index_params or ivf_pq.IndexParams(
-                n_lists=1024, pq_bits=4, pq_dim=min(64, d), seed=seed), x)
-        jax.block_until_ready(idx.list_codes)
-        # the caller's k, EXACTLY: the compilation cache is keyed by HLO and
-        # k is a static arg of _pq_search, so the old max(k, 40) override
-        # left the production k=10 program cold (ADVICE r5 medium).
-        # Pipelines that also search a refine-candidate width (e.g. k=40
-        # feeding refine to 10) warm that width with a second warmup call.
-        searcher = lambda: ivf_pq.search(
-            search_params or ivf_pq.SearchParams(
-                n_probes=8, lut_dtype="bfloat16"), idx, q, k)
-    else:  # cagra
-        from .neighbors import cagra
+            idx = ivf_pq.build(
+                index_params or ivf_pq.IndexParams(
+                    n_lists=1024, pq_bits=4, pq_dim=min(64, d), seed=seed), x)
+            jax.block_until_ready(idx.list_codes)
+            # the caller's k, EXACTLY: the compilation cache is keyed by HLO
+            # and k is a static arg of _pq_search, so the old max(k, 40)
+            # override left the production k=10 program cold (ADVICE r5
+            # medium). Pipelines that also search a refine-candidate width
+            # (e.g. k=40 feeding refine to 10) warm that width with a second
+            # warmup call.
+            searcher = lambda: ivf_pq.search(
+                search_params or ivf_pq.SearchParams(
+                    n_probes=8, lut_dtype="bfloat16"), idx, q, k)
+        else:  # cagra
+            from .neighbors import cagra
 
-        idx = cagra.build(index_params or cagra.IndexParams(seed=seed), x)
-        jax.block_until_ready(idx.graph)
-        searcher = lambda: cagra.search(
-            search_params or cagra.SearchParams(itopk_size=32), idx, q, k)
+            idx = cagra.build(index_params or cagra.IndexParams(seed=seed), x)
+            jax.block_until_ready(idx.graph)
+            searcher = lambda: cagra.search(
+                search_params or cagra.SearchParams(itopk_size=32), idx, q, k)
     build_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    jax.block_until_ready(jax.tree_util.tree_leaves(searcher())[0])
+    with obs_compile.attribution() as search_attr:
+        jax.block_until_ready(jax.tree_util.tree_leaves(searcher())[0])
     search_s = time.perf_counter() - t0
-    return {"build_s": round(build_s, 2), "search_s": round(search_s, 2),
-            "cache_dir": cache}
+
+    def _phase(wall_s, rec) -> dict:
+        return {
+            "wall_s": round(wall_s, 2),
+            **rec.summary(),
+            "program_compile_s": [round(s, 3) for s in rec.program_compile_s],
+        }
+
+    attribution = "jax.monitoring"
+    if not search_attr.available:  # pragma: no cover - ancient jax
+        # timing fallback (ops/_compat.jax_monitoring gate): a second,
+        # fully warm search bounds execute time; the cold-warm delta is the
+        # compile share of the first. Cache outcomes stay unknown (-1).
+        attribution = "timing"
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.tree_util.tree_leaves(searcher())[0])
+        warm_s = time.perf_counter() - t0
+        search_attr.compile_s = max(search_s - warm_s, 0.0)
+        search_attr.cache_hits = search_attr.cache_misses = -1
+        build_attr.cache_hits = build_attr.cache_misses = -1
+
+    out = {
+        # headline walls keep their historical keys (provisioning scripts)
+        "build_s": round(build_s, 2), "search_s": round(search_s, 2),
+        "cache_dir": cache, "attribution": attribution,
+        "build": _phase(build_s, build_attr),
+        "search": _phase(search_s, search_attr),
+    }
+    logger.info(
+        "warmup(%s, n=%d, d=%d, k=%d): build %.1fs (%.1fs compile over %d "
+        "programs), search %.1fs (%.1fs compile), cache %d hits / %d misses "
+        "at %s", kind, n, d, k, build_s, build_attr.compile_s,
+        build_attr.programs, search_s, search_attr.compile_s,
+        build_attr.cache_hits + search_attr.cache_hits,
+        build_attr.cache_misses + search_attr.cache_misses, cache)
+    return out

@@ -23,10 +23,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core import tracing
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile, _l2_expanded, pairwise_distance
+from ..obs.instrument import instrument, nrows
 from ..random.rng import as_key
 
 __all__ = [
@@ -159,6 +161,10 @@ def _init_centroids(params: KMeansParams, x, centroids, key, tile: int):
     return _kmeans_plus_plus(x, key, params.n_clusters, tile)
 
 
+@instrument("cluster.kmeans.fit",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["x"]),
+            labels=lambda a, kw: {
+                "n_clusters": (a[0] if a else kw["params"]).n_clusters})
 def fit(params: KMeansParams, x, sample_weights=None, centroids=None, res: Resources | None = None) -> KMeansOutput:
     """Fit k-means (reference: raft::cluster::kmeans::fit, cluster/kmeans.cuh;
     runtime entry raft_runtime/cluster/kmeans.hpp:53)."""
@@ -173,15 +179,19 @@ def fit(params: KMeansParams, x, sample_weights=None, centroids=None, res: Resou
     key = as_key(params.seed)
     for trial in range(max(params.n_init, 1)):
         key, kt = jax.random.split(key)
-        init_c = _init_centroids(params, x, centroids, kt, tile)
-        c, labels, inertia, n_iter = _lloyd(
-            x, init_c, w, params.n_clusters, params.max_iter, params.tol, tile
-        )
+        with tracing.range("kmeans.fit.init"):
+            init_c = _init_centroids(params, x, centroids, kt, tile)
+        with tracing.range("kmeans.fit.lloyd"):
+            c, labels, inertia, n_iter = _lloyd(
+                x, init_c, w, params.n_clusters, params.max_iter, params.tol, tile
+            )
         if best is None or float(inertia) < float(best.inertia):
             best = KMeansOutput(c, labels, inertia, int(n_iter))
     return best
 
 
+@instrument("cluster.kmeans.predict",
+            items=lambda a, kw: nrows(a[0] if a else kw["x"]))
 def predict(x, centroids, sample_weights=None, res: Resources | None = None):
     """Assign labels (reference: kmeans::predict). Returns (labels, inertia)."""
     res = res or default_resources()

@@ -51,6 +51,18 @@ def enable_compilation_cache(path: str | None = None) -> str:
     # in under a second
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    # jax latches "cache unused" once per task on the FIRST compile: if
+    # anything compiled before this call, the new dir would silently never
+    # be consulted. Reset the latch so enabling mid-process takes effect.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - private API drift: best effort
+        pass
+    from .core.logger import logger
+
+    logger.info("persistent compilation cache enabled at %s", path)
     return path
 
 
@@ -90,6 +102,14 @@ def _convert(value: Any) -> Any:
             return torch.from_dlpack(value)
     if isinstance(value, tuple):
         return tuple(_convert(v) for v in value)
+    # lists and dicts of arrays (multi-output returns) convert element-wise
+    # too — pylibraft's config converts any cai-exposing leaf; only
+    # converting tuples here silently leaked jax arrays from list/dict
+    # returns under set_output_as("numpy"/"torch")
+    if isinstance(value, list):
+        return [_convert(v) for v in value]
+    if isinstance(value, dict):
+        return {k: _convert(v) for k, v in value.items()}
     return value
 
 

@@ -11,10 +11,12 @@ natively, so this module only pins down the logger name, the level vocabulary
 from __future__ import annotations
 
 import logging
+import sys
 
 __all__ = [
     "logger",
     "set_level",
+    "basic_config",
     "OFF",
     "CRITICAL",
     "ERROR",
@@ -42,6 +44,37 @@ logger.addHandler(logging.NullHandler())
 def set_level(level: int) -> None:
     """Set the global raft_tpu log level (reference: logger::set_level)."""
     logger.setLevel(level)
+
+
+# the handler basic_config installed, so repeat calls replace instead of stack
+_handler: logging.Handler | None = None
+
+# spdlog-ish default, the reference's "[%L] [%H:%M:%S.%f] %v" spirit in
+# stdlib-formatter vocabulary
+DEFAULT_PATTERN = "[%(levelname)s] [%(asctime)s] [raft_tpu] %(message)s"
+
+
+def basic_config(level: int = INFO, pattern: str = DEFAULT_PATTERN,
+                 stream=None) -> logging.Logger:
+    """One-call formatted stderr logging (reference: logger::set_pattern +
+    the callback sink, logger-ext.hpp:34 — there users wire a sink and
+    pattern at runtime; here one call replaces hand-built stdlib handlers).
+
+    Installs (or replaces, on repeat calls) a single StreamHandler on the
+    ``raft_tpu`` logger with ``pattern`` as a stdlib logging format string,
+    sets ``level``, and stops propagation so records are not double-printed
+    through the root logger. Returns the logger. Pass a ``stream`` to
+    redirect (the callback-sink analogue: any write()-able object works).
+    """
+    global _handler
+    if _handler is not None:
+        logger.removeHandler(_handler)
+    _handler = logging.StreamHandler(stream or sys.stderr)
+    _handler.setFormatter(logging.Formatter(pattern))
+    logger.addHandler(_handler)
+    logger.setLevel(level)
+    logger.propagate = False
+    return logger
 
 
 def trace(msg: str, *args) -> None:

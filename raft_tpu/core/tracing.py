@@ -38,12 +38,21 @@ def range(name: str, *args):
 
     printf-style ``args`` are interpolated into ``name`` lazily, mirroring the
     reference's format-string labels.
+
+    Two sinks, so the range is visible wherever the work lands:
+
+    - ``jax.profiler.TraceAnnotation`` — the HOST timeline (eager phases,
+      dispatch); the direct NVTX-range analogue.
+    - ``jax.named_scope`` — the name is attached to every op staged while the
+      range is open, so xprof's DEVICE timeline (and HLO dumps) carve into
+      the same stage names. This is why ``range`` also works *inside* jitted
+      functions: there it names the traced ops rather than timing the trace.
     """
     if not _enabled:
         yield
         return
     label = name % args if args else name
-    with jax.profiler.TraceAnnotation(label):
+    with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
         yield
 
 

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.errors import expects
+from ..obs.instrument import instrument, nrows
 
 __all__ = ["select_k"]
 
@@ -51,6 +52,9 @@ def _select_k(values, in_idx, k: int, select_min: bool):
     return top_v, top_i.astype(jnp.int32)
 
 
+@instrument("matrix.select_k",
+            items=lambda a, kw: nrows(a[0] if a else kw["values"]),
+            labels=lambda a, kw: {"k": a[1] if len(a) > 1 else kw["k"]})
 @auto_convert_output
 def select_k(values, k: int, select_min: bool = True, indices=None):
     """Select the k smallest (or largest) entries per row, with their indices.

@@ -25,6 +25,7 @@ from ..core.resources import Resources, default_resources
 from ..distance.pairwise import _PRECISIONS, _choose_tile, _pairwise, _pad_to_tiles
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import select_k
+from ..obs.instrument import dtype_of, instrument, nrows
 
 __all__ = ["knn", "knn_merge_parts", "BruteForce"]
 
@@ -182,6 +183,14 @@ def _bf_knn(dataset, queries, k: int, metric: DistanceType, metric_arg: float,
     return dists, idx
 
 
+@instrument(
+    "brute_force.knn",
+    items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["queries"]),
+    labels=lambda a, kw: {
+        "dtype": dtype_of(a[0] if a else kw["dataset"]),
+        "k": a[2] if len(a) > 2 else kw["k"],
+    },
+)
 @auto_convert_output
 def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
         sample_filter=None, mode: str = "exact", compute: str = "float32",

@@ -34,12 +34,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core import tracing
 from ..core.errors import expects
 from ..core.logger import logger
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.types import DistanceType, resolve_metric
+from ..obs.instrument import dtype_of, instrument, nrows
 from ..random.rng import as_key
 from . import ivf_pq as ivf_pq_mod
 from .refine import refine
@@ -504,6 +506,10 @@ def estimate_seed_pool(dataset, knn_graph, seed: int = 0) -> int:
     return pool
 
 
+@instrument("cagra.build",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["dataset"]),
+            labels=lambda a, kw: {
+                "dtype": dtype_of(a[1] if len(a) > 1 else kw["dataset"])})
 def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIndex:
     """Full CAGRA build (reference: cagra::build, cagra.cuh; the int8_t /
     uint8_t instantiations map to byte datasets here: the index stores the
@@ -527,9 +533,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 
         kind = str(x.dtype)
         x = _as_signed(x)  # stored (and scored) in the shifted s8 domain
-    knn_graph = build_knn_graph(params, x, res=res)
+    with tracing.range("cagra.build.knn_graph"):
+        knn_graph = build_knn_graph(params, x, res=res)
     hint = estimate_seed_pool(x, knn_graph, seed=params.seed)
-    graph = optimize(knn_graph, params.graph_degree, res=res)
+    with tracing.range("cagra.build.optimize"):
+        graph = optimize(knn_graph, params.graph_degree, res=res)
     return CagraIndex(dataset=x, graph=graph, metric=mt, data_kind=kind,
                       seed_pool_hint=hint)
 
@@ -757,6 +765,12 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int,
     return params.hop_impl
 
 
+@instrument(
+    "cagra.search",
+    items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["queries"]),
+    labels=lambda a, kw: {"k": a[3] if len(a) > 3 else kw["k"],
+                          "itopk": (a[0] if a else kw["params"]).itopk_size},
+)
 @auto_convert_output
 def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,

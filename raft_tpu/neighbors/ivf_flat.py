@@ -33,6 +33,7 @@ from jax import lax
 
 from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
+from ..core import tracing
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
@@ -40,6 +41,7 @@ from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scal
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
+from ..obs.instrument import dtype_of, instrument, nrows
 from ._list_utils import (assign_to_lists, bound_capacity, list_positions,
                           plan_search_tiles, round_up)
 
@@ -187,6 +189,12 @@ def _resolve_storage(list_dtype: str, x, mt: DistanceType):
     return ld, x, x.astype(jnp.float32)
 
 
+@instrument("ivf_flat.build",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["dataset"]),
+            labels=lambda a, kw: {
+                "dtype": dtype_of(a[1] if len(a) > 1 else kw["dataset"]),
+                "n_lists": (a[0] if a else kw["params"]).n_lists,
+            })
 def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlatIndex:
     """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh;
     coarse centers via balanced k-means on a training subsample, then fill)."""
@@ -216,7 +224,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
         n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
         max_train_points=min(max_train, n),
     )
-    centers = kmeans_balanced.fit(kb, xf, params.n_lists, res=res)
+    with tracing.range("ivf_flat.build.coarse_kmeans"):
+        centers = kmeans_balanced.fit(kb, xf, params.n_lists, res=res)
 
     storage = {"bfloat16": jnp.bfloat16, "int8": jnp.int8,
                "uint8": jnp.int8}.get(kind, x.dtype)
@@ -252,6 +261,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
     )
 
 
+@instrument("ivf_flat.extend",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["new_vectors"]))
 def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None = None,
            split_factor: float | None = None) -> IvfFlatIndex:
     """Append vectors (reference: ivf_flat::extend, ivf_flat-inl.cuh:160,287).
@@ -290,7 +301,8 @@ def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
     xa = x.astype(jnp.float32) if x.dtype == jnp.int8 else x
-    labels = assign_to_lists(xa, index.centers, index.metric, tile)
+    with tracing.range("ivf_flat.extend.assign"):
+        labels = assign_to_lists(xa, index.centers, index.metric, tile)
 
     # merge with existing list contents (flatten old lists back to rows)
     if index.capacity > 0 and index.size > 0:
@@ -317,7 +329,8 @@ def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
     labels, rep, n_lists, capacity, spatial = bound_capacity(
         labels, index.n_lists, sf, x=x.astype(jnp.float32))
     centers = index.centers
-    data, idbuf, norms, sizes = _fill_lists(x, new_ids, labels, n_lists, capacity)
+    with tracing.range("ivf_flat.extend.fill_lists"):
+        data, idbuf, norms, sizes = _fill_lists(x, new_ids, labels, n_lists, capacity)
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
         if spatial is not None and spatial.any():
@@ -346,11 +359,12 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
     inner = metric == DistanceType.InnerProduct
 
     # ---- stage 1: coarse scoring (ref: ivf_flat_search-inl.cuh:130) ----
-    cscore = qf @ index.centers.T  # (m, L) MXU
-    if not inner:
-        cn = jnp.sum(index.centers * index.centers, axis=1)
-        cscore = cn[None, :] - 2.0 * cscore
-    _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+    with tracing.range("ivf_flat.search.coarse"):
+        cscore = qf @ index.centers.T  # (m, L) MXU
+        if not inner:
+            cn = jnp.sum(index.centers * index.centers, axis=1)
+            cscore = cn[None, :] - 2.0 * cscore
+        _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
 
     # pad queries to tile multiple
     num = -(-m // query_tile)
@@ -406,7 +420,8 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
         ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
         return _select_k(cv, ci, k, not inner)
 
-    dists, idx = lax.map(per_tile, (qt, pt))
+    with tracing.range("ivf_flat.search.scan"):
+        dists, idx = lax.map(per_tile, (qt, pt))
     dists = dists.reshape(num * query_tile, k)[:m]
     idx = idx.reshape(num * query_tile, k)[:m]
     if not inner:
@@ -422,6 +437,12 @@ def _ivf_search(index: IvfFlatIndex, queries, n_probes: int, k: int,
     return dists, idx
 
 
+@instrument(
+    "ivf_flat.search",
+    items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["queries"]),
+    labels=lambda a, kw: {"k": a[3] if len(a) > 3 else kw["k"],
+                          "n_probes": (a[0] if a else kw["params"]).n_probes},
+)
 @auto_convert_output
 def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
            sample_filter=None, res: Resources | None = None):

@@ -36,8 +36,10 @@ from jax import lax
 
 from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
+from ..core import tracing
 from ..core.errors import expects
 from ..core.logger import logger
+from ..obs.instrument import dtype_of, instrument, nrows
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               serialize_header, serialize_mdspan, serialize_scalar)
@@ -527,6 +529,12 @@ def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int, consts=Non
     return buf, idbuf, counts.astype(jnp.int32), cbuf
 
 
+@instrument("ivf_pq.build",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["dataset"]),
+            labels=lambda a, kw: {
+                "dtype": dtype_of(a[1] if len(a) > 1 else kw["dataset"]),
+                "n_lists": (a[0] if a else kw["params"]).n_lists,
+            })
 def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIndex:
     """Build the index (reference: ivf_pq::build, ivf_pq-inl.cuh:270; call
     stack SURVEY.md §3.B)."""
@@ -560,7 +568,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
         max_train_points=min(max_train, n),
     )
-    centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
+    with tracing.range("ivf_pq.build.coarse_kmeans"):
+        centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
 
     # 2. rotation (ref step 3)
     key, kr = jax.random.split(key)
@@ -578,9 +587,10 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     else:
         xt = x
     tile = _choose_tile(n_train, params.n_lists, 1, res.workspace_bytes)
-    labels = assign_to_lists(xt, centers, mt, tile)
-    resid = (xt.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
-    resid = resid.reshape(n_train, pq_dim, pq_len)
+    with tracing.range("ivf_pq.build.residuals"):
+        labels = assign_to_lists(xt, centers, mt, tile)
+        resid = (xt.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
+        resid = resid.reshape(n_train, pq_dim, pq_len)
 
     # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
     key, kc = jax.random.split(key)
@@ -597,7 +607,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     if kind != "per_cluster":
         # (pq_dim, n_train, pq_len) — every subspace trains on all residuals
         sub = jnp.moveaxis(resid, 1, 0)
-        codebooks = train(sub)
+        with tracing.range("ivf_pq.build.train_codebooks"):
+            codebooks = train(sub)
         # codebook-kind heuristic: for "auto" ONLY, trial-train per-cluster
         # codebooks on the largest clusters and adopt them when they quantize
         # markedly better (the caller opted into the trial + possible ~3x
@@ -635,7 +646,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         rows = jnp.take(order, starts[:, None] + offs)  # (n_lists, pool_cap)
         pools = jnp.take(resid.reshape(n_train, d_rot), rows, axis=0)  # (L, pool_cap, d_rot)
         pools = pools.reshape(params.n_lists, pool_cap * pq_dim, pq_len)
-        codebooks = train(pools)
+        with tracing.range("ivf_pq.build.train_codebooks"):
+            codebooks = train(pools)
 
     index = IvfPqIndex(
         centers=centers,
@@ -704,6 +716,8 @@ def _check_split_consts(index: IvfPqIndex) -> None:
                 "populate them", index.list_ids.shape, index.list_consts.shape)
 
 
+@instrument("ivf_pq.extend",
+            items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["new_vectors"]))
 def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None = None,
            split_factor: float | None = None) -> IvfPqIndex:
     """Encode + append vectors (reference: ivf_pq::extend; encode path
@@ -737,7 +751,8 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
         new_ids = jnp.asarray(new_ids, jnp.int32)
 
     tile = _choose_tile(n_new, index.n_lists, 1, res.workspace_bytes)
-    labels = assign_to_lists(x, index.centers, index.metric, tile)
+    with tracing.range("ivf_pq.extend.assign"):
+        labels = assign_to_lists(x, index.centers, index.metric, tile)
     resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
     resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
     per_cluster = index.codebook_kind == "per_cluster"
@@ -747,11 +762,12 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     enc_cb = _composed_codebooks(index.codebooks) if index.pq_split else index.codebooks
     n_codes = enc_cb.shape[-2]
     enc_tile = max(min(n_new, res.workspace_bytes // max(index.pq_dim * n_codes * 4, 1)), 8)
-    codes = _encode(
-        resid, enc_cb, labels,
-        per_cluster=per_cluster,
-        tile=min(enc_tile, 8192),
-    )
+    with tracing.range("ivf_pq.extend.encode"):
+        codes = _encode(
+            resid, enc_cb, labels,
+            per_cluster=per_cluster,
+            tile=min(enc_tile, 8192),
+        )
     consts = None
     if index.pq_split and index.metric != DistanceType.InnerProduct:
         # L2 scoring needs the per-vector cross term; IP scoring is exactly
@@ -785,8 +801,9 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
         centers_rot = jnp.asarray(np.repeat(np.asarray(centers_rot), rep, axis=0))
         if index.codebook_kind == "per_cluster":
             codebooks = jnp.asarray(np.repeat(np.asarray(codebooks), rep, axis=0))
-    buf, idbuf, sizes, cbuf = _fill_code_lists(
-        codes, new_ids, labels, n_lists, capacity, consts)
+    with tracing.range("ivf_pq.extend.fill_lists"):
+        buf, idbuf, sizes, cbuf = _fill_code_lists(
+            codes, new_ids, labels, n_lists, capacity, consts)
     return dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
         list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
@@ -809,11 +826,12 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
     n_codes = index.codebooks.shape[-2]
 
     # ---- stage 1: coarse clusters (ref select_clusters :68) ----
-    cscore = qf @ index.centers.T
-    if not inner:
-        cn = jnp.sum(index.centers * index.centers, axis=1)
-        cscore = cn[None, :] - 2.0 * cscore
-    _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+    with tracing.range("ivf_pq.search.coarse"):
+        cscore = qf @ index.centers.T
+        if not inner:
+            cn = jnp.sum(index.centers * index.centers, axis=1)
+            cscore = cn[None, :] - 2.0 * cscore
+        _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
 
     # rotated queries
     qrot = qf @ index.rotation.T  # (m, d_rot)
@@ -962,7 +980,8 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
         ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
         return _select_k(cv, ci, k, not inner)
 
-    dists, idx = lax.map(per_tile, (qt, pt))
+    with tracing.range("ivf_pq.search.scan"):
+        dists, idx = lax.map(per_tile, (qt, pt))
     dists = dists.reshape(num * query_tile, k)[:m]
     idx = idx.reshape(num * query_tile, k)[:m]
     if not inner and metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
@@ -1148,6 +1167,12 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
     return dists, idx
 
 
+@instrument(
+    "ivf_pq.search",
+    items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["queries"]),
+    labels=lambda a, kw: {"k": a[3] if len(a) > 3 else kw["k"],
+                          "n_probes": (a[0] if a else kw["params"]).n_probes},
+)
 @auto_convert_output
 def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
            sample_filter=None, res: Resources | None = None):

@@ -1,0 +1,42 @@
+"""raft_tpu.obs — the observability surface (metrics, compile attribution,
+instrumentation).
+
+The reference operates through NVTX ranges (core/nvtx.hpp:95), spdlog runtime
+control, and a bench harness that always writes structured results
+(benchmark.hpp:111-200). The TPU rebuild's analogue is this package:
+
+- :mod:`.metrics` — zero-dependency counters/gauges/histograms with labels;
+  ``snapshot()`` (nested dict), ``to_prometheus()`` (text exposition format
+  for scraping), ``to_json()`` (flat, subtractable — BENCH artifacts).
+- :mod:`.instrument` — the ``@instrument`` decorator applied across the
+  search/build/prims entry points (brute_force/ivf_flat/ivf_pq/cagra,
+  pairwise_distance, select_k, kmeans).
+- :mod:`.compile` — jax.monitoring subscription splitting compile vs execute
+  and counting persistent-cache hits/misses.
+
+Trace annotation (the NVTX analogue) lives in :mod:`raft_tpu.core.tracing`;
+per-collective counters ride inside :mod:`raft_tpu.comms.comms`.
+
+``disable()`` turns the whole surface off; the remaining overhead per
+instrumented call is a single module-flag check (guarded by the
+``obs_overhead`` smoke test in tier-1). See docs/observability.md for the
+metric catalogue.
+"""
+
+from . import compile  # noqa: A004 - submodule named like the builtin
+from . import metrics
+from .compile import CompileRecord, attribution
+# NOTE: this deliberately rebinds the package attribute `obs.instrument` from
+# the submodule to the decorator (the ergonomic call site); reach the helper
+# fns via `from raft_tpu.obs.instrument import nrows`, not attribute access.
+from .instrument import instrument
+from .metrics import (DEFAULT_BUCKETS, Registry, counter, delta, disable,
+                      enable, enabled, gauge, histogram, quantile, reset,
+                      snapshot, to_json, to_prometheus)
+
+__all__ = [
+    "metrics", "compile", "instrument", "attribution", "CompileRecord",
+    "Registry", "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
+    "snapshot", "to_prometheus", "to_json", "delta", "quantile", "reset",
+    "enable", "disable", "enabled",
+]

@@ -1,7 +1,22 @@
-"""jax-version compatibility aliases shared by the Pallas kernels."""
+"""jax-version compatibility aliases shared by the Pallas kernels and obs."""
 
 from jax.experimental.pallas import tpu as pltpu
 
 # pltpu.CompilerParams was named TPUCompilerParams before jax 0.5; the
 # kernels only pass vmem_limit_bytes, which both spellings accept.
 CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+
+def jax_monitoring():
+    """The ``jax.monitoring`` event bus when this jax ships one with listener
+    registration (0.4.x+), else None. obs/compile.py keys its compile
+    attribution on this; callers without it fall back to wall-time deltas
+    (the cold-vs-warm timing fallback in ``_warmup``)."""
+    try:
+        from jax import monitoring
+    except ImportError:  # pragma: no cover - ancient jax
+        return None
+    if not (hasattr(monitoring, "register_event_duration_secs_listener")
+            and hasattr(monitoring, "register_event_listener")):
+        return None  # pragma: no cover - pre-listener jax
+    return monitoring

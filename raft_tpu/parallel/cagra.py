@@ -25,9 +25,11 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..comms.comms import Comms, replicated, shard_along
+from ..core import tracing
 from ..core.errors import expects
 from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
+from ..obs.instrument import instrument, nrows
 from ..random.rng import as_key
 from ..neighbors.cagra import (CagraIndex, IndexParams, SearchParams, _cagra_search,
                                resolve_hop_impl, resolve_max_iterations,
@@ -70,6 +72,9 @@ class ShardedCagraIndex:
         return cls(*children, metric=aux[0], data_kind=kind)
 
 
+@instrument("parallel.cagra.build",
+            items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["dataset"]),
+            labels=lambda a, kw: {"size": (a[0] if a else kw["comms"]).size()})
 def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
     """Build one CAGRA graph per shard (host loop; on a multi-host pod each
     host builds only its own shard — the graphs are fully independent)."""
@@ -80,8 +85,9 @@ def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
             n, size)
     rows = n // size
     expects(params.graph_degree < rows, "graph_degree must be < rows per shard (%d)", rows)
-    shards = [build_single(params, dataset[s * rows:(s + 1) * rows])
-              for s in range(size)]
+    with tracing.range("parallel.cagra.build.shards"):
+        shards = [build_single(params, dataset[s * rows:(s + 1) * rows])
+                  for s in range(size)]
     return ShardedCagraIndex(
         dataset=jnp.stack([s.dataset for s in shards]),
         graph=jnp.stack([s.graph for s in shards]),
@@ -90,6 +96,10 @@ def build(comms: Comms, params: IndexParams, dataset) -> ShardedCagraIndex:
     )
 
 
+@instrument("parallel.cagra.search",
+            items=lambda a, kw: nrows(a[3] if len(a) > 3 else kw["queries"]),
+            labels=lambda a, kw: {"k": a[4] if len(a) > 4 else kw["k"],
+                                  "size": (a[0] if a else kw["comms"]).size()})
 def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
            queries, k: int):
     """Distributed CAGRA search: per-shard beam search + ICI merge.
@@ -143,18 +153,20 @@ def _cagra_search_fn(comms: Comms, k: int, itopk: int, max_iter: int,
     inner = metric == DistanceType.InnerProduct
 
     def step(data, graph, q, key):
-        shard = CagraIndex(dataset=data[0], graph=graph[0], metric=metric)
-        d_loc, i_loc = _cagra_search(shard, q, key, k, itopk,
-                                     max_iter, width, sqrt_out, seed_pool,
-                                     hop_impl)
-        i_glob = jnp.where(i_loc >= 0,
-                           i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
-        d_all = comms.allgather(d_loc)
-        i_all = comms.allgather(i_glob)
-        m = q.shape[0]
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, not inner)
+        with tracing.range("parallel.cagra.local_search"):
+            shard = CagraIndex(dataset=data[0], graph=graph[0], metric=metric)
+            d_loc, i_loc = _cagra_search(shard, q, key, k, itopk,
+                                         max_iter, width, sqrt_out, seed_pool,
+                                         hop_impl)
+        with tracing.range("parallel.cagra.merge"):
+            i_glob = jnp.where(i_loc >= 0,
+                               i_loc + comms.rank().astype(jnp.int32) * rows, i_loc)
+            d_all = comms.allgather(d_loc)
+            i_all = comms.allgather(i_glob)
+            m = q.shape[0]
+            d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+            i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+            return _select_k(d_flat, i_flat, k, not inner)
 
     axis = comms.axis
     return jax.jit(comms.shard_map(

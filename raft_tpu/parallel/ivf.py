@@ -34,10 +34,12 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..comms.comms import Comms, replicated, shard_along
+from ..core import tracing
 from ..core.errors import expects
 from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
 from ..neighbors.ivf_flat import IvfFlatIndex, SearchParams, _ivf_search
+from ..obs.instrument import instrument, nrows
 
 __all__ = ["build", "build_pq", "extend", "search", "search_pq"]
 
@@ -92,19 +94,21 @@ def _flat_search_fn(comms: Comms, n_probes: int, k: int, metric,
     inner = metric == DistanceType.InnerProduct
 
     def step(centers, data, ids, norms, sizes, q):
-        shard = IvfFlatIndex(centers, data, ids, norms, sizes, metric,
-                             split_factor, data_kind)
-        d_loc, i_loc = _ivf_search(
-            shard, q, n_probes, k,
-            query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
-            metric=metric,
-        )
-        d_all = comms.allgather(d_loc)  # (S, m, k) over ICI
-        i_all = comms.allgather(i_loc)
-        m = q.shape[0]
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, not inner)
+        with tracing.range("parallel.ivf.local_search"):
+            shard = IvfFlatIndex(centers, data, ids, norms, sizes, metric,
+                                 split_factor, data_kind)
+            d_loc, i_loc = _ivf_search(
+                shard, q, n_probes, k,
+                query_tile=min(256, q.shape[0]), probe_chunk=n_probes,
+                metric=metric,
+            )
+        with tracing.range("parallel.ivf.merge"):
+            d_all = comms.allgather(d_loc)  # (S, m, k) over ICI
+            i_all = comms.allgather(i_loc)
+            m = q.shape[0]
+            d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+            i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+            return _select_k(d_flat, i_flat, k, not inner)
 
     axis = comms.axis
     return jax.jit(comms.shard_map(
@@ -114,6 +118,10 @@ def _flat_search_fn(comms: Comms, n_probes: int, k: int, metric,
     ))
 
 
+@instrument("parallel.ivf.search",
+            items=lambda a, kw: nrows(a[3] if len(a) > 3 else kw["queries"]),
+            labels=lambda a, kw: {"k": a[4] if len(a) > 4 else kw["k"],
+                                  "size": (a[0] if a else kw["comms"]).size()})
 def search(comms: Comms, params: SearchParams, index: IvfFlatIndex, queries, k: int):
     """Distributed IVF-Flat search (multi-chip analogue of ivf_flat.search).
 
@@ -198,6 +206,10 @@ def _pad_pq_lists(index, size: int):
     )
 
 
+@instrument("parallel.ivf.search_pq",
+            items=lambda a, kw: nrows(a[3] if len(a) > 3 else kw["queries"]),
+            labels=lambda a, kw: {"k": a[4] if len(a) > 4 else kw["k"],
+                                  "size": (a[0] if a else kw["comms"]).size()})
 def search_pq(comms: Comms, params, index, queries, k: int,
               res=None):
     """Distributed IVF-PQ search: lists sharded over the mesh axis, local LUT
@@ -289,23 +301,25 @@ def _pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
 
     def step(centers, centers_rot, rotation, codebooks, codes, ids, sizes,
              consts, q):
-        shard = IvfPqIndex(
-            centers, centers_rot, rotation, codebooks, codes, ids, sizes,
-            list_consts=consts,
-            metric=metric, codebook_kind=codebook_kind,
-            pq_bits=pq_bits, split_factor=split_factor,
-            pq_split=pq_split)
-        d_loc, i_loc = _pq_search(
-            shard, q, n_probes, k,
-            query_tile=query_tile, probe_chunk=probe_chunk,
-            metric=metric, codebook_kind=codebook_kind,
-            lut_dtype=lut_dtype, scan_impl=scan_impl)
-        d_all = comms.allgather(d_loc)
-        i_all = comms.allgather(i_loc)
-        m = q.shape[0]
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, not inner)
+        with tracing.range("parallel.ivf.local_search_pq"):
+            shard = IvfPqIndex(
+                centers, centers_rot, rotation, codebooks, codes, ids, sizes,
+                list_consts=consts,
+                metric=metric, codebook_kind=codebook_kind,
+                pq_bits=pq_bits, split_factor=split_factor,
+                pq_split=pq_split)
+            d_loc, i_loc = _pq_search(
+                shard, q, n_probes, k,
+                query_tile=query_tile, probe_chunk=probe_chunk,
+                metric=metric, codebook_kind=codebook_kind,
+                lut_dtype=lut_dtype, scan_impl=scan_impl)
+        with tracing.range("parallel.ivf.merge"):
+            d_all = comms.allgather(d_loc)
+            i_all = comms.allgather(i_loc)
+            m = q.shape[0]
+            d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+            i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+            return _select_k(d_flat, i_flat, k, not inner)
 
     axis = comms.axis
     cb_spec = P(axis) if per_cluster else P()
@@ -448,6 +462,9 @@ def _build_capacity(gcounts, extra=0) -> int:
     return round_up(max(int(np.asarray(gcounts).max()) + extra, 8), 8)
 
 
+@instrument("parallel.ivf.build",
+            items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["dataset"]),
+            labels=lambda a, kw: {"size": (a[0] if a else kw["comms"]).size()})
 def build(comms: Comms, params, dataset, res=None) -> IvfFlatIndex:
     """Distributed IVF-Flat build: dataset rows sharded over ``comms.axis``,
     index lists sharded the way :func:`search` consumes them. ``params`` is
@@ -483,9 +500,10 @@ def build(comms: Comms, params, dataset, res=None) -> IvfFlatIndex:
 
     keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
     xs = shard_along(mesh, axis, x)
-    centers, labels, gcounts = jax.jit(comms.shard_map(
-        phase1, in_specs=(P(axis), P()),
-        out_specs=(P(), P(axis), P())))(xs, keys)
+    with tracing.range("parallel.ivf.build.coarse_kmeans"):
+        centers, labels, gcounts = jax.jit(comms.shard_map(
+            phase1, in_specs=(P(axis), P()),
+            out_specs=(P(), P(axis), P())))(xs, keys)
     cap = _build_capacity(gcounts)
 
     def phase3(x_shard, lab, ids):
@@ -501,15 +519,18 @@ def build(comms: Comms, params, dataset, res=None) -> IvfFlatIndex:
         return data.astype(storage), idb, nrm
 
     ids = shard_along(mesh, axis, jnp.arange(n, dtype=jnp.int32))
-    data, idb, nrm = jax.jit(comms.shard_map(
-        phase3, in_specs=(P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis))))(xs, labels, ids)
+    with tracing.range("parallel.ivf.build.fill_lists"):
+        data, idb, nrm = jax.jit(comms.shard_map(
+            phase3, in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=(P(axis), P(axis), P(axis))))(xs, labels, ids)
     return IvfFlatIndex(
         centers=centers, list_data=data, list_ids=idb, list_norms=nrm,
         list_sizes=gcounts, metric=mt, split_factor=params.split_factor,
         data_kind=kind)
 
 
+@instrument("parallel.ivf.extend",
+            items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["new_vectors"]))
 def extend(comms: Comms, index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfFlatIndex:
     """Distributed IVF-Flat extend: new rows sharded over the mesh axis are
     assigned and appended shard-locally; old list contents never leave their
@@ -594,6 +615,9 @@ def extend(comms: Comms, index: IvfFlatIndex, new_vectors, new_ids=None) -> IvfF
         split_factor=index.split_factor, data_kind=index.data_kind)
 
 
+@instrument("parallel.ivf.build_pq",
+            items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["dataset"]),
+            labels=lambda a, kw: {"size": (a[0] if a else kw["comms"]).size()})
 def build_pq(comms: Comms, params, dataset, res=None):
     """Distributed IVF-PQ build (``params`` =
     :class:`raft_tpu.neighbors.ivf_pq.IndexParams`): same three phases as
@@ -643,9 +667,10 @@ def build_pq(comms: Comms, params, dataset, res=None):
 
     keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
     xs = shard_along(mesh, axis, x)
-    centers, labels, gcounts = jax.jit(comms.shard_map(
-        phase1, in_specs=(P(axis), P()),
-        out_specs=(P(), P(axis), P())))(xs, keys)
+    with tracing.range("parallel.ivf.build_pq.coarse_kmeans"):
+        centers, labels, gcounts = jax.jit(comms.shard_map(
+            phase1, in_specs=(P(axis), P()),
+            out_specs=(P(), P(axis), P())))(xs, keys)
     cap = _build_capacity(gcounts)
 
     # phase 2: rotation (host, deterministic from the seed — replicated
@@ -671,9 +696,10 @@ def build_pq(comms: Comms, params, dataset, res=None):
             sub_pools, kk[1], n_codes, params.kmeans_n_iters)
 
     cb_keys = replicated(mesh, jnp.stack([keys[0], kc]))
-    codebooks = jax.jit(comms.shard_map(
-        phase2, in_specs=(P(axis), P(axis), P(), P()),
-        out_specs=P()))(xs, labels, centers, cb_keys)
+    with tracing.range("parallel.ivf.build_pq.train_codebooks"):
+        codebooks = jax.jit(comms.shard_map(
+            phase2, in_specs=(P(axis), P(axis), P(), P()),
+            out_specs=P()))(xs, labels, centers, cb_keys)
 
     # phase 3: shard-local encode + block fill
     enc_cb_host = (pq_mod._composed_codebooks(codebooks) if split
@@ -696,11 +722,12 @@ def build_pq(comms: Comms, params, dataset, res=None):
         return out[0].astype(jnp.uint8), out[1] - 1, cbuf
 
     ids = shard_along(mesh, axis, jnp.arange(n, dtype=jnp.int32))
-    codes_arr, idb, cbuf = jax.jit(comms.shard_map(
-        phase3, in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
-        out_specs=(P(axis), P(axis), P(axis))))(
-        xs, labels, ids, centers, replicated(mesh, enc_cb_host),
-        replicated(mesh, codebooks))
+    with tracing.range("parallel.ivf.build_pq.encode_fill"):
+        codes_arr, idb, cbuf = jax.jit(comms.shard_map(
+            phase3, in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(axis), P(axis), P(axis))))(
+            xs, labels, ids, centers, replicated(mesh, enc_cb_host),
+            replicated(mesh, codebooks))
     return pq_mod.IvfPqIndex(
         centers=centers, centers_rot=centers @ rotation.T, rotation=rotation,
         codebooks=codebooks, list_codes=codes_arr, list_ids=idb,

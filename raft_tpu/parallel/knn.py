@@ -24,10 +24,12 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..comms.comms import Comms, replicated, shard_along
+from ..core import tracing
 from ..core.errors import expects
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
 from ..neighbors.brute_force import _bf_knn, _bf_knn_fused, _fused_eligible
+from ..obs.instrument import instrument, nrows
 
 __all__ = ["knn"]
 
@@ -45,22 +47,24 @@ def _knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
     select_min = mt != DistanceType.InnerProduct
 
     def local_search(x_shard, q, keep_shard):
-        if use_fused:
-            return _bf_knn_fused(x_shard, q, k, mt, compute, keep_shard)
-        comp = "float32" if compute == "float32x3" else compute
-        return _bf_knn(x_shard, q, k, mt, metric_arg,
-                       min(tile, q.shape[0]), inner_tile, keep_shard,
-                       compute=comp)
+        with tracing.range("parallel.knn.local_search"):
+            if use_fused:
+                return _bf_knn_fused(x_shard, q, k, mt, compute, keep_shard)
+            comp = "float32" if compute == "float32x3" else compute
+            return _bf_knn(x_shard, q, k, mt, metric_arg,
+                           min(tile, q.shape[0]), inner_tile, keep_shard,
+                           compute=comp)
 
     def merge(d_loc, i_loc, m):
-        i_glob = jnp.where(i_loc >= 0,
-                           i_loc + comms.rank().astype(jnp.int32) * shard_rows,
-                           -1)
-        d_all = comms.allgather(d_loc)
-        i_all = comms.allgather(i_glob)
-        d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
-        i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
-        return _select_k(d_flat, i_flat, k, select_min)
+        with tracing.range("parallel.knn.merge"):
+            i_glob = jnp.where(i_loc >= 0,
+                               i_loc + comms.rank().astype(jnp.int32) * shard_rows,
+                               -1)
+            d_all = comms.allgather(d_loc)
+            i_all = comms.allgather(i_glob)
+            d_flat = jnp.moveaxis(d_all, 0, 1).reshape(m, size * k)
+            i_flat = jnp.moveaxis(i_all, 0, 1).reshape(m, size * k)
+            return _select_k(d_flat, i_flat, k, select_min)
 
     if has_keep:
         def step(x_shard, keep_shard, q):
@@ -79,6 +83,10 @@ def _knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
         step, in_specs=(P(comms.axis), P()), out_specs=(P(), P())))
 
 
+@instrument("parallel.knn",
+            items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["queries"]),
+            labels=lambda a, kw: {"k": a[3] if len(a) > 3 else kw["k"],
+                                  "size": (a[0] if a else kw["comms"]).size()})
 def knn(comms: Comms, dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
         tile: int = 2048, inner_tile: int = 512, compute: str = "float32"):
     """Distributed exact kNN (multi-chip analogue of brute_force.knn).

@@ -88,6 +88,26 @@ def test_row_guard_success_and_error_paths():
     assert rows[0]["error"].startswith("ValueError")
 
 
+def test_no_metrics_flag_disables_obs(monkeypatch):
+    """`bench.py --no-metrics` must switch the whole obs surface off (the
+    disabled-path proof ISSUE 2 asks for): rows then carry no "obs" field
+    and the emitted snapshot says metrics_enabled=false."""
+    import bench
+    from raft_tpu import obs
+
+    called = {}
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: called.setdefault("ran", True))
+    try:
+        rc = bench.main(["--no-metrics"])
+        assert rc == 0 and called["ran"]
+        assert bench._STATE["metrics"] is False
+        assert not obs.enabled()
+    finally:
+        obs.enable()
+        bench._STATE["metrics"] = True
+
+
 def test_flagship_i8_row_smoke(monkeypatch):
     """The driver-bench i8 rows (this PR's acceptance measurement) must
     produce qps+recall rows, not guarded error rows, when the kernels run —
