@@ -1,0 +1,81 @@
+"""Distributed-build overhead A/B: ``parallel.ivf.build`` vs ``ivf_flat.build``
+on a 1-device mesh (VERDICT r5 item 8).
+
+The search drivers got this control in r05 (per-call retrace found and fixed
+to ~0%); the build drivers never did. On a 1-device mesh the distributed
+build pays its full orchestration — psum-EM coarse training, the S-step
+list-block psum fill, shard_map staging — with ZERO communication to hide it,
+so the A/B bounds the pure driver overhead. Run on hardware:
+
+    python bench/build_ab.py --n 1000000 --d 128 --n-lists 1024
+
+Emits one JSON line: cold + warm walls for both paths and the warm ratio
+(warm is what a steady-state pipeline pays; cold is dominated by compile and
+attributed separately via raft_tpu.obs). The CPU-mesh variant of this A/B is
+recorded in BASELINE.md ("Round-6 distributed-build overhead study").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def measure(n: int, d: int, n_lists: int, repeats: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.comms import Comms
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.parallel import ivf as pivf
+
+    obs_compile.install()
+    comms = Comms(Mesh(np.array(jax.devices()[:1]), ("data",)), "data")
+    x = jax.random.uniform(jax.random.key(0), (n, d), jnp.float32)
+    jax.block_until_ready(x)
+    params = ivf_flat.IndexParams(n_lists=n_lists, seed=0)
+
+    def timed(fn):
+        walls, compile_s = [], []
+        for _ in range(repeats + 1):
+            t0 = time.perf_counter()
+            with obs_compile.attribution() as rec:
+                idx = fn()
+                jax.block_until_ready(idx.list_data)
+            walls.append(time.perf_counter() - t0)
+            compile_s.append(rec.compile_s)
+            del idx
+        # first call is cold (compile-dominated); best of the rest is warm
+        return {"cold_s": round(walls[0], 2),
+                "cold_compile_s": round(compile_s[0], 2),
+                "warm_s": round(min(walls[1:]), 2)}
+
+    single = timed(lambda: ivf_flat.build(params, x))
+    dist = timed(lambda: pivf.build(comms, params, x))
+    return {
+        "n": n, "d": d, "n_lists": n_lists,
+        "single": single, "distributed": dist,
+        "warm_overhead": round(dist["warm_s"] / single["warm_s"] - 1.0, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--d", type=int, default=128)
+    ap.add_argument("--n-lists", type=int, default=1024)
+    ap.add_argument("--repeats", type=int, default=2)
+    args = ap.parse_args()
+    print(json.dumps(measure(args.n, args.d, args.n_lists, args.repeats)),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -26,6 +26,9 @@ Subpackages (lazily imported):
   obs        metrics registry + compile attribution            (ref: nvtx/spdlog/bench harness)
   ops        Pallas TPU kernels backing the hot paths
   parallel   distributed (sharded) algorithm drivers           (ref: raft::comms consumers)
+  serve      online serving: micro-batching, versioned hot-swap registry,
+             admission control                                 (no ref counterpart — SURVEY §5
+                                                                leaves scheduling to the user)
 """
 
 import importlib
@@ -50,6 +53,7 @@ _SUBMODULES = {
     "obs",
     "ops",
     "parallel",
+    "serve",
     "spatial",
     "config",
 }
@@ -58,9 +62,11 @@ _SUBMODULES = {
 def __getattr__(name):
     if name in _SUBMODULES:
         return importlib.import_module(f".{name}", __name__)
-    if name == "warmup":  # AOT cache warmup entry point (docs/warm_builds.md)
-        fn = importlib.import_module("._warmup", __name__).warmup
-        globals()["warmup"] = fn
+    if name in ("warmup", "warm_buckets"):  # AOT cache warmup entry points
+        # (docs/warm_builds.md; warm_buckets is the serving-bucket variant
+        # the serve registry warms hot-swaps through — docs/serving.md)
+        fn = getattr(importlib.import_module("._warmup", __name__), name)
+        globals()[name] = fn
         return fn
     raise AttributeError(f"module 'raft_tpu' has no attribute {name!r}")
 

@@ -31,9 +31,61 @@ from __future__ import annotations
 import time
 from typing import Any
 
-__all__ = ["warmup"]
+__all__ = ["warmup", "warm_buckets"]
 
 _KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
+
+
+def _random_queries(key, rows: int, d: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    if dtype == "float32":
+        return jax.random.uniform(key, (rows, d), jnp.float32)
+    lo, hi = (-128, 128) if dtype == "int8" else (0, 256)
+    return jax.random.randint(key, (rows, d), lo, hi, jnp.int32).astype(dtype)
+
+
+def warm_buckets(searcher, *, dim: int, buckets, k: int = 10,
+                 dtype: str = "float32", seed: int = 0) -> dict:
+    """Compile-warm one serving searcher at every batch-shape bucket.
+
+    The serving-layer half of :func:`warmup` (raft_tpu.serve): a micro-
+    batched service flushes only the padded power-of-two shapes in
+    ``buckets``, so running ``searcher(queries, k)`` once per bucket — with
+    queries drawn in the index's own query ``dtype`` — compiles the exact
+    program set the hot path will dispatch. The serve registry calls this
+    from ``publish`` BEFORE flipping the active pointer (warm hot-swap);
+    provisioning scripts can call it directly to populate the persistent
+    cache off the serving path (enable the cache first, see
+    :func:`raft_tpu.config.enable_compilation_cache`).
+
+    Returns ``{bucket: {wall_s, compile_s, trace_s, programs, cache_hits,
+    cache_misses}}`` via the obs compile-attribution subscription — all-warm
+    buckets report ``compile_s == 0``, which is the zero-hiccup-swap proof
+    ``bench.py --serve`` asserts.
+    """
+    import jax
+
+    from .core.errors import expects
+    from .obs import compile as obs_compile
+
+    expects(dtype in ("float32", "int8", "uint8"),
+            "dtype must be 'float32', 'int8' or 'uint8', got %r", dtype)
+    out = {}
+    key = jax.random.key(seed)
+    for b in sorted(set(int(b) for b in buckets)):
+        expects(b >= 1, "bucket sizes must be >= 1, got %d", b)
+        key, kq = jax.random.split(key)
+        q = _random_queries(kq, b, dim, dtype)
+        jax.block_until_ready(q)
+        t0 = time.perf_counter()
+        with obs_compile.attribution() as rec:
+            jax.block_until_ready(
+                jax.tree_util.tree_leaves(searcher(q, k))[0])
+        out[b] = {"wall_s": round(time.perf_counter() - t0, 3),
+                  **rec.summary()}
+    return out
 
 
 def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,

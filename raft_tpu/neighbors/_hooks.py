@@ -1,0 +1,33 @@
+"""Shared constructor for the ``batched_searcher`` serving hooks.
+
+Every index module exposes ``batched_searcher(index, params) -> fn`` where
+``fn(queries, k) -> (distances, ids)`` carries ``kind``/``dim``/
+``query_dtype`` attributes — the stable surface :mod:`raft_tpu.serve`
+dispatches, warms, and hot-swaps through. The hook CONTRACT lives here in
+one place (attribute set, byte-dtype rule); the per-module functions only
+supply the search closure, so a contract change cannot silently miss one
+index kind.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["make_hook"]
+
+
+def make_hook(search_fn: Callable, kind: str, dim: int,
+              data_kind: str = "float32") -> Callable:
+    """Wrap ``search_fn(queries, k)`` as a serving hook. ``data_kind`` is
+    the index's storage contract: byte indexes ("int8"/"uint8") serve byte
+    queries of the SAME dtype (serve warmup draws them that way, so the s8
+    programs compile exactly as production runs them); everything else
+    serves float32."""
+
+    def fn(queries, k):
+        return search_fn(queries, k)
+
+    fn.kind = kind
+    fn.dim = int(dim)
+    fn.query_dtype = data_kind if data_kind in ("int8", "uint8") else "float32"
+    return fn

@@ -313,3 +313,16 @@ class BruteForce:
     def search(self, queries, k: int, res: Resources | None = None):
         expects(self.dataset is not None, "index is not built")
         return knn(self.dataset, queries, k, self.metric, self.metric_arg, res=res)
+
+
+def batched_searcher(index: BruteForce, params=None):
+    """Stable serving hook (raft_tpu.serve; contract in
+    :mod:`._hooks`): ``fn(queries, k) -> (distances, ids)`` with
+    ``.kind``/``.dim``/``.query_dtype`` attributes. Brute force has no
+    search params; ``params`` must be None."""
+    from ._hooks import make_hook
+
+    expects(index.dataset is not None, "index is not built")
+    expects(params is None, "brute_force has no search params")
+    return make_hook(index.search, "brute_force",
+                     index.dataset.shape[1], str(index.dataset.dtype))

@@ -823,3 +823,14 @@ def load(path: str, res: Resources | None = None) -> CagraIndex:
         graph = jnp.asarray(deserialize_mdspan(f))
     return CagraIndex(dataset=dataset, graph=graph, metric=metric,
                       data_kind=kind, seed_pool_hint=hint)
+
+
+def batched_searcher(index: CagraIndex, params: SearchParams | None = None):
+    """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
+    the surface the serve registry warms and hot-swaps through. The serving
+    ``k`` must satisfy ``k <= itopk_size`` (search()'s own precondition)."""
+    from ._hooks import make_hook
+
+    sp = params or SearchParams()
+    return make_hook(lambda queries, k: search(sp, index, queries, k),
+                     "cagra", index.dim, index.data_kind)

@@ -522,3 +522,13 @@ def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
         kind = "bfloat16" if data.dtype == jnp.bfloat16 else "float32"
     return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor,
                         kind)
+
+
+def batched_searcher(index: IvfFlatIndex, params: SearchParams | None = None):
+    """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
+    the surface the serve registry warms and hot-swaps through."""
+    from ._hooks import make_hook
+
+    sp = params or SearchParams()
+    return make_hook(lambda queries, k: search(sp, index, queries, k),
+                     "ivf_flat", index.dim, index.data_kind)

@@ -1283,3 +1283,15 @@ def load(path: str, res: Resources | None = None) -> IvfPqIndex:
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
                       split_factor=split_factor, pq_split=pq_split,
                       data_kind=kind)
+
+
+def batched_searcher(index: IvfPqIndex, params: SearchParams | None = None):
+    """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
+    the surface the serve registry warms and hot-swaps through. For the
+    candidates+refine serving pattern, publish a hook built by the caller
+    (serve accepts any callable with the hook attributes)."""
+    from ._hooks import make_hook
+
+    sp = params or SearchParams()
+    return make_hook(lambda queries, k: search(sp, index, queries, k),
+                     "ivf_pq", index.dim, index.data_kind)

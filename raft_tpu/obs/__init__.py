@@ -15,7 +15,9 @@ control, and a bench harness that always writes structured results
   and counting persistent-cache hits/misses.
 
 Trace annotation (the NVTX analogue) lives in :mod:`raft_tpu.core.tracing`;
-per-collective counters ride inside :mod:`raft_tpu.comms.comms`.
+per-collective counters ride inside :mod:`raft_tpu.comms.comms`; the serving
+layer's queue/occupancy/swap metrics ride inside :mod:`raft_tpu.serve`
+(``raft_tpu_serve_*`` — docs/serving.md).
 
 ``disable()`` turns the whole surface off; the remaining overhead per
 instrumented call is a single module-flag check (guarded by the

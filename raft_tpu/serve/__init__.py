@@ -1,0 +1,40 @@
+"""raft_tpu.serve — the online serving layer.
+
+The reference ships kernels and leaves request scheduling to the user
+(SURVEY §5: its parallelism is intra-kernel plus user-composed sharding over
+``raft::comms``); a TPU deployment "serving heavy traffic from millions of
+users" (ROADMAP north star) needs the host-side half of the story:
+
+- :mod:`.batcher` — dynamic micro-batching of concurrent callers into a
+  small fixed set of padded power-of-two batch shapes (the warmed program
+  set), flushing on batch-full or a ``max_wait_us`` deadline;
+- :mod:`.registry` — versioned index registry with warm, atomic hot-swap:
+  ``publish`` compiles the new index against the serving buckets BEFORE the
+  flip, in-flight requests drain on the old version, retired versions free
+  their arrays;
+- :mod:`.service` — :class:`SearchService`: admission control (bounded
+  queue with fast-fail :class:`OverloadedError`), per-request deadlines
+  (expired requests dropped before batching), clean shutdown/drain;
+- :mod:`.errors` — the fast-fail vocabulary.
+
+Observability rides on :mod:`raft_tpu.obs` (queue-depth gauge, wait/occupancy
+histograms, swap/overload/deadline counters — catalogue in
+docs/observability.md) and flushes are tracing-annotated as
+``serve/flush/<bucket>`` for xprof. Worked example + bucket/overload policy:
+docs/serving.md.
+"""
+
+from . import batcher, errors, registry, service
+from .batcher import MicroBatcher, bucket_for, bucket_sizes
+from .errors import (DeadlineExceededError, OverloadedError, ServeError,
+                     ServiceClosedError)
+from .registry import IndexRegistry, make_searcher
+from .service import SearchService
+
+__all__ = [
+    "batcher", "registry", "service", "errors",
+    "MicroBatcher", "bucket_sizes", "bucket_for",
+    "IndexRegistry", "make_searcher", "SearchService",
+    "ServeError", "OverloadedError", "DeadlineExceededError",
+    "ServiceClosedError",
+]

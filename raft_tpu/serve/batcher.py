@@ -1,0 +1,392 @@
+"""Dynamic micro-batcher: many callers, few program shapes.
+
+Every search entry point in this repo is a bare library call with a static
+batch shape — on TPU each new batch size is a new XLA program (cold jit).
+The reference leaves request scheduling entirely to the user (its
+parallelism is intra-kernel, SURVEY §5); the host-side leverage on TPU is to
+aggregate concurrent single-query callers into a SMALL FIXED SET of padded
+batch shapes so the serving path runs exactly the programs that were warmed
+and nothing else.
+
+Mechanics: callers :meth:`MicroBatcher.submit` row blocks and get
+``concurrent.futures.Future`` objects; a background worker drains the queue
+into the next power-of-two *bucket* (1, 2, 4, ... ``max_batch``), flushing
+when ``max_batch`` rows are pending or the oldest request has waited
+``max_wait_us``, pads the concatenated rows up to the bucket, runs the flush
+function ONCE, and scatters per-row results back to the futures. The bucket
+ladder bounds the jitted-program set to ``log2(max_batch)+1`` shapes per
+stream — the set :func:`raft_tpu.serve.registry.IndexRegistry.publish`
+pre-warms so a hot-swap never cold-jits on the serving path.
+
+Determinism for tests: the wall clock is injected (``clock``) and the worker
+thread is optional (``start=False``); :meth:`pump` performs one synchronous
+drain-and-flush, so every queue policy (deadline expiry, bucket choice,
+occupancy) is assertable without sleeping. The background worker is a thin
+loop around the same drain path.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+from ..core import tracing
+from ..core.errors import expects
+from ..obs import metrics
+from .errors import DeadlineExceededError, ServiceClosedError
+
+__all__ = ["MicroBatcher", "bucket_sizes", "bucket_for"]
+
+# occupancy = valid rows / bucket rows, in (0, 1]; the ladder resolves the
+# half-full-vs-full distinction that drives padding waste
+_OCCUPANCY_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _queue_depth():
+    return metrics.gauge(
+        "raft_tpu_serve_queue_depth",
+        "rows currently queued in a serve stream (pre-batching)")
+
+
+@functools.lru_cache(maxsize=None)
+def _wait_seconds():
+    return metrics.histogram(
+        "raft_tpu_serve_wait_seconds",
+        "per-request queue wait from submit to batch drain", unit="seconds")
+
+
+@functools.lru_cache(maxsize=None)
+def _occupancy():
+    return metrics.histogram(
+        "raft_tpu_serve_batch_occupancy",
+        "valid rows / bucket rows per flush (1.0 = no padding waste)",
+        buckets=_OCCUPANCY_BUCKETS)
+
+
+@functools.lru_cache(maxsize=None)
+def _flush_total():
+    return metrics.counter(
+        "raft_tpu_serve_flush_total", "flushes per serve stream and bucket")
+
+
+@functools.lru_cache(maxsize=None)
+def _deadline_total():
+    return metrics.counter(
+        "raft_tpu_serve_deadline_expired_total",
+        "requests dropped at drain (or refused at submit) past deadline")
+
+
+@functools.lru_cache(maxsize=None)
+def _error_total():
+    return metrics.counter(
+        "raft_tpu_serve_flush_errors_total",
+        "flushes whose flush_fn raised (all rows in the batch fail)")
+
+
+def _fail(future: Future, exc: Exception) -> None:
+    """set_exception tolerant of a caller's concurrent ``cancel()`` — a
+    cancelled future is already resolved, and failing to fail it must not
+    kill the worker thread (the rest of the batch still needs its results)."""
+    try:
+        future.set_exception(exc)
+    except Exception:  # cancelled/already-resolved: the caller moved on
+        pass
+
+
+def bucket_sizes(max_batch: int) -> tuple[int, ...]:
+    """The power-of-two bucket ladder ``(1, 2, 4, ..., max_batch)``."""
+    expects(max_batch >= 1 and (max_batch & (max_batch - 1)) == 0,
+            "max_batch must be a power of two, got %d", max_batch)
+    sizes, b = [], 1
+    while b <= max_batch:
+        sizes.append(b)
+        b *= 2
+    return tuple(sizes)
+
+
+def bucket_for(n_rows: int, max_batch: int) -> int:
+    """Smallest ladder bucket holding ``n_rows``."""
+    b = 1
+    while b < n_rows:
+        b *= 2
+    return min(b, max_batch)
+
+
+@dataclass
+class _Request:
+    rows: object           # (r, d) array, r >= 1
+    n: int
+    future: Future
+    enqueued: float        # clock() at submit
+    deadline: float | None  # clock()-domain absolute deadline, or None
+
+
+@dataclass
+class _Drained:
+    """One drain's outcome: the batch to flush + expired requests to fail."""
+
+    batch: list = field(default_factory=list)
+    rows: int = 0
+    expired: list = field(default_factory=list)
+
+
+class MicroBatcher:
+    """Thread-safe dynamic micro-batcher for one serve stream.
+
+    ``flush_fn(padded_queries) -> tuple_of_arrays`` receives a
+    ``(bucket, d)`` array (zero-padded past the valid rows) and must return
+    a tuple/list of arrays whose leading dimension is ``bucket`` (e.g.
+    ``(distances, ids)``); the batcher slices rows back per request. Rows
+    beyond the valid count are padding — their results are discarded, so
+    the flush function never needs a mask.
+
+    One batcher serves ONE stream (one index name at one ``k``): all
+    submissions must share ``d`` and dtype, otherwise they could not share
+    a program shape. The service layer keys batchers by ``(name, k)``.
+    """
+
+    def __init__(self, flush_fn: Callable[[object], Sequence],
+                 *, max_batch: int = 64, max_wait_us: float = 1000.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 stream: str = "default", start: bool = True,
+                 on_dequeue: Callable[[int], None] | None = None):
+        expects(max_wait_us >= 0, "max_wait_us must be >= 0")
+        self._flush_fn = flush_fn
+        self.max_batch = int(max_batch)
+        self.buckets = bucket_sizes(self.max_batch)
+        self.max_wait_s = float(max_wait_us) * 1e-6
+        self._clock = clock
+        self.stream = stream
+        self._cond = threading.Condition()
+        self._pending: list[_Request] = []
+        self._pending_rows = 0
+        # rows must share one program shape: the first submission pins the
+        # stream's (d, dtype) and mismatches fail at the door — a mismatch
+        # reaching batch assembly would kill the worker mid-flush instead
+        self._row_shape: tuple | None = None
+        # notified (rows removed) whenever queued rows leave the queue —
+        # the service's O(1) admission counter; must only take leaf locks
+        self._on_dequeue = on_dequeue
+        self._closed = False
+        self._worker: threading.Thread | None = None
+        if start:
+            self._worker = threading.Thread(
+                target=self._run, name=f"raft-serve-{stream}", daemon=True)
+            self._worker.start()
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, rows, *, deadline: float | None = None) -> Future:
+        """Enqueue a ``(r, d)`` row block; returns a Future resolving to the
+        per-row slice of the flush result. ``deadline`` is absolute, in the
+        injected clock's domain. Raises :class:`ServiceClosedError` after
+        :meth:`close`; a request wider than ``max_batch`` is refused (split
+        at the caller — one request never spans two flushes)."""
+        expects(getattr(rows, "ndim", 0) == 2,
+                "submit expects a (rows, d) block")
+        n = int(rows.shape[0])
+        expects(1 <= n <= self.max_batch,
+                "request rows (%d) must be in [1, max_batch=%d]",
+                n, self.max_batch)
+        shape = (int(rows.shape[1]), str(rows.dtype))
+        fut: Future = Future()
+        now = self._clock()
+        with self._cond:
+            if self._closed:
+                raise ServiceClosedError(f"stream {self.stream!r} is closed")
+            if self._row_shape is None:
+                self._row_shape = shape
+            else:
+                expects(shape == self._row_shape,
+                        "stream %r batches (*, %d) %s rows; got (*, %d) %s",
+                        self.stream, self._row_shape[0], self._row_shape[1],
+                        shape[0], shape[1])
+            self._pending.append(_Request(rows, n, fut, now, deadline))
+            self._pending_rows += n
+            if metrics._enabled:
+                _queue_depth().set(self._pending_rows, stream=self.stream)
+            self._cond.notify()
+        return fut
+
+    def pending_rows(self) -> int:
+        with self._cond:
+            return self._pending_rows
+
+    # -- draining -----------------------------------------------------------
+    def _next_deadline_locked(self) -> float | None:
+        dls = [r.deadline for r in self._pending if r.deadline is not None]
+        return min(dls) if dls else None
+
+    def _ready_locked(self, now: float) -> bool:
+        if not self._pending:
+            return False
+        if self._closed or self._pending_rows >= self.max_batch:
+            return True
+        return now - self._pending[0].enqueued >= self.max_wait_s
+
+    def _sweep_expired_locked(self, now: float) -> list:
+        """Remove expired requests ANYWHERE in the queue — before batching,
+        so they consume no device time. Expiry is decoupled from flush
+        readiness on purpose: one tight-deadline client must not trigger an
+        early under-full flush of its fresh queue-mates (the worker wakes
+        for the earliest deadline, sweeps, and goes back to waiting)."""
+        expired = [r for r in self._pending
+                   if r.deadline is not None and now >= r.deadline]
+        if not expired:
+            return []
+        self._pending = [r for r in self._pending
+                         if r.deadline is None or now < r.deadline]
+        removed = sum(r.n for r in expired)
+        self._pending_rows = max(self._pending_rows - removed, 0)
+        if metrics._enabled:
+            _queue_depth().set(self._pending_rows, stream=self.stream)
+            _deadline_total().inc(len(expired), stream=self.stream)
+        if self._on_dequeue is not None:
+            self._on_dequeue(removed)
+        return expired
+
+    def _drain_locked(self, now: float) -> _Drained:
+        """Pop up to ``max_batch`` rows of whole requests (expired ones were
+        already swept by the caller at the same ``now``). Caller-cancelled
+        futures are dropped (cancellation is honored as long as the request
+        has not been drained; once drained, ``set_running_or_notify_cancel``
+        pins the future so the flush's ``set_result`` cannot race a late
+        ``cancel()``)."""
+        out = _Drained()
+        removed_start = self._pending_rows
+        while self._pending:
+            r = self._pending[0]
+            if out.rows + r.n > self.max_batch:
+                break
+            self._pending.pop(0)
+            if not r.future.set_running_or_notify_cancel():
+                self._pending_rows -= r.n  # cancelled while queued: drop
+                continue
+            out.batch.append(r)
+            out.rows += r.n
+        self._pending_rows = max(self._pending_rows - out.rows, 0)
+        if metrics._enabled:
+            _queue_depth().set(self._pending_rows, stream=self.stream)
+        removed = removed_start - self._pending_rows
+        if removed and self._on_dequeue is not None:
+            self._on_dequeue(removed)
+        return out
+
+    def _flush_expired(self, drained: _Drained, now: float) -> None:
+        for r in drained.expired:
+            _fail(r.future, DeadlineExceededError(
+                f"deadline expired after {now - r.enqueued:.6f}s in queue "
+                f"(stream {self.stream!r})"))
+
+    def _flush(self, drained: _Drained, now: float) -> int:
+        # Batch assembly and result scatter are PURE NumPy on purpose: eager
+        # jnp concats/slices would be a fresh tiny XLA program per request-
+        # size combination, breaking the serving path's zero-cold-compile
+        # property (the warmed program set must be exactly the bucket
+        # shapes). The device sees only the padded (bucket, d) array.
+        self._flush_expired(drained, now)
+        batch = drained.batch
+        if not batch:
+            return 0
+        import numpy as np
+
+        n_valid = drained.rows
+        bucket = bucket_for(n_valid, self.max_batch)
+        if metrics._enabled:
+            for r in batch:
+                _wait_seconds().observe(now - r.enqueued, stream=self.stream)
+            _occupancy().observe(n_valid / bucket, stream=self.stream)
+            _flush_total().inc(1, stream=self.stream, bucket=bucket)
+        try:
+            # assembly stays INSIDE the guard: the drained futures are
+            # already pinned (set_running_or_notify_cancel), so any escape
+            # here would kill the worker and strand them unresolved
+            q = (np.asarray(batch[0].rows) if len(batch) == 1
+                 else np.concatenate([np.asarray(r.rows) for r in batch]))
+            if n_valid < bucket:
+                pad = np.zeros((bucket - n_valid,) + q.shape[1:], q.dtype)
+                q = np.concatenate([q, pad])
+            with tracing.range("serve/flush/%d", bucket):
+                out = tuple(np.asarray(a) for a in self._flush_fn(q))
+        except Exception as e:
+            _error_total().inc(1, stream=self.stream)
+            for r in batch:
+                _fail(r.future, e)
+            return n_valid
+        off = 0
+        for r in batch:
+            r.future.set_result(tuple(a[off:off + r.n] for a in out))
+            off += r.n
+        return n_valid
+
+    def pump(self, *, force: bool = False) -> int:
+        """Synchronously sweep expired requests, then drain-and-flush once if
+        the flush condition holds; returns rows flushed (0 when nothing
+        flushed — pass ``force=True`` to flush regardless, e.g. when
+        draining at shutdown). This is the deterministic test/drain entry;
+        the worker thread uses the same sweep/drain path."""
+        now = self._clock()
+        with self._cond:
+            expired = self._sweep_expired_locked(now)
+            drained = (self._drain_locked(now)
+                       if force or self._ready_locked(now) else _Drained())
+            drained.expired = expired
+        return self._flush(drained, now)
+
+    # -- worker -------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                now = self._clock()
+                expired = self._sweep_expired_locked(now)
+                while (not expired and not self._closed
+                       and not self._ready_locked(now)):
+                    if self._pending:
+                        elapsed = now - self._pending[0].enqueued
+                        timeout = self.max_wait_s - elapsed
+                        nd = self._next_deadline_locked()
+                        if nd is not None:  # wake for the earliest deadline
+                            timeout = min(timeout, nd - now)
+                        self._cond.wait(max(timeout, 0.0))
+                    else:
+                        self._cond.wait()
+                    now = self._clock()
+                    expired = self._sweep_expired_locked(now)
+                if self._closed and not self._pending and not expired:
+                    return
+                drained = (self._drain_locked(now)
+                           if self._closed or self._ready_locked(now)
+                           else _Drained())
+                drained.expired = expired
+            self._flush(drained, now)
+
+    def close(self, *, drain: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop the stream. ``drain=True`` flushes everything still queued
+        (each remaining request completes normally); ``drain=False`` fails
+        pending futures with :class:`ServiceClosedError`. Idempotent."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                pending, self._pending = self._pending, []
+                cleared, self._pending_rows = self._pending_rows, 0
+                if metrics._enabled:
+                    _queue_depth().set(0, stream=self.stream)
+                if cleared and self._on_dequeue is not None:
+                    self._on_dequeue(cleared)
+            self._cond.notify_all()
+        if not drain:
+            for r in pending:
+                _fail(r.future, ServiceClosedError(
+                    f"stream {self.stream!r} shut down with drain=False"))
+        if self._worker is not None:
+            self._worker.join(timeout_s)
+            self._worker = None
+        if drain:
+            # whether or not a worker existed, anything still queued (e.g.
+            # submitted in the join race, or no-worker mode) flushes here
+            while self.pump(force=True):
+                pass

@@ -1,0 +1,42 @@
+"""Serving-layer error types.
+
+All derive from :class:`raft_tpu.core.errors.RaftError` so a caller's
+existing ``except RaftError`` fences keep working; the three subclasses are
+the serving layer's fast-fail vocabulary (the reference leaves request
+scheduling to the user, so it has no counterpart — these follow the standard
+serving taxonomy: overload, deadline, shutdown).
+"""
+
+from __future__ import annotations
+
+from ..core.errors import RaftError
+
+__all__ = ["ServeError", "OverloadedError", "DeadlineExceededError",
+           "ServiceClosedError"]
+
+
+class ServeError(RaftError):
+    """Base for serving-layer failures."""
+
+
+class OverloadedError(ServeError):
+    """Admission control rejected the request: the queue is at its bound.
+
+    Raised synchronously from ``submit`` — the caller finds out in
+    microseconds, not after its deadline (fast-fail is the point: shed load
+    at the door, never queue work that cannot be served in time).
+    """
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline expired.
+
+    Either synchronously at submit (deadline already in the past) or set on
+    the request's future when the batcher drains the queue — expired
+    requests are dropped BEFORE being batched, so an overloaded service
+    never burns device time on results nobody is waiting for.
+    """
+
+
+class ServiceClosedError(ServeError):
+    """The service (or one of its streams) has been shut down."""

@@ -1,0 +1,255 @@
+"""Versioned index registry with warm, atomic hot-swap.
+
+A production index is rebuilt continuously (fresh embeddings, streaming
+inserts compacted offline); the serving fleet must replace it UNDER LOAD.
+Three properties make a swap safe on TPU:
+
+1. **Warm before visible** — :meth:`IndexRegistry.publish` runs the new
+   index's searcher at every serving bucket shape (``_warmup.warm_buckets``)
+   BEFORE flipping the active pointer. The jit/persistent-cache key is the
+   HLO, and a rebuilt index of the same static config (n_lists, pq_dim,
+   itopk, dtype, bucket shapes) is the SAME set of programs — so a swap
+   costs zero cold compiles on the hot path, and the publish report proves
+   it (compile attribution per bucket, from :mod:`raft_tpu.obs.compile`).
+2. **Atomic flip, lease-pinned flushes** — the active pointer changes under
+   a lock; an in-flight FLUSH holds a :meth:`lease` on the version it
+   resolved and finishes on it (requests still queued at the flip are
+   served by the new version at their flush — same stream contract,
+   enforced at publish, so the difference is invisible to callers). No
+   request ever sees half a swap.
+3. **Retire after drain** — an unpublished version is dropped (index arrays
+   released to the allocator) only when its lease count reaches zero.
+
+The registry dispatches through each index module's ``batched_searcher``
+hook (the stable serving surface of ``neighbors/*``), so it works uniformly
+for brute-force, IVF-Flat, IVF-PQ and CAGRA — including the int8/uint8
+byte-dataset variants, whose warmup queries are drawn in the index's own
+query dtype so the s8 programs compile exactly as production runs them.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..core.errors import RaftError, expects
+from ..obs import metrics
+
+__all__ = ["IndexRegistry", "make_searcher", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+@functools.lru_cache(maxsize=None)
+def _swap_total():
+    return metrics.counter(
+        "raft_tpu_serve_swap_total",
+        "hot-swaps (publishes that replaced a live version)")
+
+
+@functools.lru_cache(maxsize=None)
+def _retired_total():
+    return metrics.counter(
+        "raft_tpu_serve_retired_total",
+        "index versions retired after their last lease drained")
+
+
+@functools.lru_cache(maxsize=None)
+def _versions_live():
+    return metrics.gauge(
+        "raft_tpu_serve_versions_live", "live (leasable) versions per name")
+
+
+def make_searcher(index, search_params=None) -> Callable:
+    """Resolve an index object to its module's ``batched_searcher`` hook:
+    a ``fn(queries, k) -> (distances, ids)`` closure carrying ``.kind``,
+    ``.dim`` and ``.query_dtype`` attributes. Raises for unknown types."""
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    for mod, cls in ((brute_force, brute_force.BruteForce),
+                     (ivf_flat, ivf_flat.IvfFlatIndex),
+                     (ivf_pq, ivf_pq.IvfPqIndex),
+                     (cagra, cagra.CagraIndex)):
+        if isinstance(index, cls):
+            return mod.batched_searcher(index, search_params)
+    raise RaftError(
+        f"no serving hook for index type {type(index).__name__!r} "
+        "(expected BruteForce, IvfFlatIndex, IvfPqIndex or CagraIndex)")
+
+
+@dataclass
+class _Version:
+    """One published version of one name. ``leases`` counts in-flight
+    flushes pinned to it; ``active=False`` + ``leases==0`` → retire."""
+
+    name: str
+    version: int
+    searcher: Callable
+    published_at: float
+    ks: tuple = (10,)  # serving widths this version was published (warmed) for
+    active: bool = True
+    leases: int = 0
+    warm_report: dict = field(default_factory=dict)
+
+
+class IndexRegistry:
+    """Thread-safe name → versioned-searcher registry (see module doc)."""
+
+    def __init__(self, *, buckets: tuple = DEFAULT_BUCKETS,
+                 clock: Callable[[], float] = time.monotonic):
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        expects(bool(self.buckets) and self.buckets[0] >= 1,
+                "buckets must be positive batch sizes")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active: dict[str, _Version] = {}
+        self._versions: dict[str, list[_Version]] = {}
+        # publishes serialize PER NAME (warm-then-flip must not interleave
+        # for one name), but a slow warm of one index must not block an
+        # urgent hot-swap of another
+        self._publish_locks: dict[str, threading.Lock] = {}
+
+    # -- publish / swap -----------------------------------------------------
+    def publish(self, name: str, index, *, search_params=None,
+                k: int | tuple = 10, version: int | None = None,
+                warm: bool = True) -> dict:
+        """Make ``(index, search_params)`` the active version of ``name``.
+
+        Warms the searcher at every registry bucket shape for every ``k``
+        (pass the tuple of widths production serves) BEFORE the flip, so the
+        swap is invisible to the hot path; returns a report with the new
+        version number and per-``k`` per-bucket compile attribution — a
+        publish against an already-warm program set reports
+        ``compile_s == 0`` everywhere, which is the hiccup-free-swap proof
+        (asserted by ``bench.py --serve``). ``warm=False`` skips warmup
+        (provisioning scripts that warmed out-of-band).
+        """
+        from .._warmup import warm_buckets
+
+        if callable(index) and hasattr(index, "kind"):
+            # pre-built hook: its params are baked into the closure, so a
+            # search_params here would be silently ignored — refuse instead
+            expects(search_params is None,
+                    "search_params has no effect on a pre-built hook "
+                    "(%r bakes its own); build the hook with them",
+                    getattr(index, "kind", "?"))
+            searcher = index
+        else:
+            searcher = make_searcher(index, search_params)
+        ks = (k,) if isinstance(k, int) else tuple(k)
+        with self._lock:
+            plock = self._publish_locks.setdefault(name, threading.Lock())
+        with plock:
+            # a replacement must preserve the stream contract: batchers pin
+            # (d, dtype) per stream and queued requests flush on the version
+            # active at drain, so a dim/dtype-changing republish would fail
+            # queued batches and wedge the stream. A new contract is a new
+            # NAME, validated here BEFORE the warmup spend.
+            with self._lock:
+                prev = self._active.get(name)
+            if prev is not None:
+                expects(
+                    searcher.dim == prev.searcher.dim
+                    and searcher.query_dtype == prev.searcher.query_dtype,
+                    "publish(%r): new version serves (%d, %s) but the live "
+                    "version serves (%d, %s) — a changed stream contract "
+                    "must be published under a new name", name,
+                    searcher.dim, searcher.query_dtype,
+                    prev.searcher.dim, prev.searcher.query_dtype)
+                # widths are part of the contract too: narrowing would cold-
+                # compile queued requests of a dropped width (flushes lease
+                # the NEW version) and lock that width's live callers out
+                expects(set(prev.ks) <= set(int(kk) for kk in ks),
+                        "publish(%r): live widths %s must be kept (got %s) "
+                        "— dropping a width orphans its live stream",
+                        name, prev.ks, tuple(ks))
+            report: dict = {"name": name, "warmed": warm, "warm": {}}
+            if warm:
+                for kk in ks:
+                    report["warm"][int(kk)] = warm_buckets(
+                        searcher, dim=searcher.dim,
+                        dtype=searcher.query_dtype,
+                        buckets=self.buckets, k=int(kk))
+            to_retire: list[_Version] = []
+            with self._lock:
+                old = self._active.get(name)
+                if version is None:
+                    version = (old.version + 1) if old is not None else 1
+                else:
+                    expects(old is None or version > old.version,
+                            "version %d must exceed the active version %d",
+                            version, old.version if old else -1)
+                v = _Version(name, int(version), searcher,
+                             self._clock(), ks=tuple(int(kk) for kk in ks),
+                             warm_report=report["warm"])
+                self._versions.setdefault(name, []).append(v)
+                self._active[name] = v
+                if old is not None:
+                    old.active = False
+                    _swap_total().inc(1, name=name)
+                    if old.leases == 0:
+                        to_retire.append(old)
+                        self._versions[name].remove(old)
+                _versions_live().set(len(self._versions[name]), name=name)
+            for dead in to_retire:
+                self._retire(dead)
+            report["version"] = v.version
+            return report
+
+    def _retire(self, v: _Version) -> None:
+        # drop the searcher closure — it owns the only registry reference
+        # to the index arrays, so this releases them to the allocator
+        v.searcher = None
+        _retired_total().inc(1, name=v.name)
+
+    # -- read side ----------------------------------------------------------
+    def active(self, name: str) -> _Version:
+        """Metadata access ONLY (``version``/``ks``/``published_at``): the
+        returned object is live, and a concurrent publish may retire it —
+        nulling ``searcher`` — the instant it is replaced. To CALL the
+        searcher, hold a :meth:`lease`."""
+        with self._lock:
+            v = self._active.get(name)
+        if v is None:
+            raise RaftError(f"no index published under {name!r}")
+        return v
+
+    @contextlib.contextmanager
+    def lease(self, name: str):
+        """Pin the active version for one flush: yields the version object
+        (use ``.searcher``); the version cannot be retired while leased — a
+        flush finishes on the version it leased even if a publish flips the
+        pointer mid-flush. (Queued requests not yet flushed lease whatever
+        is active at their drain; publish enforces that replacements keep
+        the stream contract, so that is indistinguishable to callers.)"""
+        with self._lock:
+            v = self._active.get(name)
+            if v is None:
+                raise RaftError(f"no index published under {name!r}")
+            v.leases += 1
+        try:
+            yield v
+        finally:
+            retire = None
+            with self._lock:
+                v.leases -= 1
+                if not v.active and v.leases == 0:
+                    retire = v
+                    self._versions[v.name].remove(v)
+                    _versions_live().set(
+                        len(self._versions[v.name]), name=v.name)
+            if retire is not None:
+                self._retire(retire)
+
+    def names(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._active))
+
+    def live_versions(self, name: str) -> tuple:
+        """Version numbers still leasable (active + draining)."""
+        with self._lock:
+            return tuple(v.version for v in self._versions.get(name, ()))

@@ -1,0 +1,281 @@
+"""SearchService: batcher + registry + admission control.
+
+The front door of the serving layer. One service owns:
+
+- an :class:`~raft_tpu.serve.registry.IndexRegistry` (shared or private) —
+  publish/hot-swap the indexes it serves;
+- one :class:`~raft_tpu.serve.batcher.MicroBatcher` per *stream* (an index
+  name at one ``k``), created lazily — submissions to the same stream share
+  program shapes, so they can share batches;
+- **admission control**: a bounded queue (``max_queue_rows`` across all
+  streams). At the bound, :meth:`submit` raises
+  :class:`~raft_tpu.serve.errors.OverloadedError` synchronously — load is
+  shed at the door in microseconds, not discovered at the deadline. Each
+  request may carry a deadline; requests that expire while queued are
+  dropped at drain, BEFORE any device work is spent on them.
+
+The flush path resolves the registry lease per flush, so a
+:meth:`publish` hot-swap takes effect on the next flush while in-flight
+batches finish on the version they started with — zero requests fail
+across a swap (asserted by ``tests/test_serve.py`` and ``bench.py
+--serve``).
+
+Determinism for tests: pass ``start_workers=False`` plus an injected
+``clock`` and drive the queues with :meth:`pump` — every admission,
+deadline and batching decision is then synchronous and clock-exact (the
+``serve`` tier-1 marker runs with no wall-clock sleeps in assertions).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable
+
+from ..core import tracing
+from ..core.errors import expects
+from ..obs import metrics
+from .batcher import MicroBatcher, bucket_sizes, _deadline_total
+from .errors import (DeadlineExceededError, OverloadedError,
+                     ServiceClosedError)
+from .registry import IndexRegistry
+
+__all__ = ["SearchService"]
+
+
+@functools.lru_cache(maxsize=None)
+def _overload_total():
+    return metrics.counter(
+        "raft_tpu_serve_overload_total",
+        "requests refused at admission (queue at max_queue_rows)")
+
+
+@functools.lru_cache(maxsize=None)
+def _requests_total():
+    return metrics.counter(
+        "raft_tpu_serve_requests_total", "requests admitted per stream")
+
+
+class _RowCounter:
+    """Service-wide queued-row count with an atomic bounded add.
+
+    LEAF lock: it is touched from under the service lock (submit) and from
+    under batcher condition locks (drain callbacks), so it must never take
+    another lock itself — that is what keeps the lock order acyclic."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def try_add(self, n: int) -> bool:
+        with self._lock:
+            if self._n + n > self.limit:
+                return False
+            self._n += n
+            return True
+
+    def sub(self, n: int) -> None:
+        with self._lock:
+            self._n = max(self._n - n, 0)
+
+    def value(self) -> int:
+        with self._lock:
+            return self._n
+
+
+class SearchService:
+    """Online k-NN serving over the registry's indexes (see module doc).
+
+    ``max_batch`` fixes the bucket ladder (and therefore the warmed program
+    set) for every stream; ``max_wait_us`` is the batching latency budget —
+    a lone request waits at most this long before flushing under-full.
+    ``default_timeout_s`` applies to requests submitted without an explicit
+    timeout (``None`` = no deadline).
+    """
+
+    def __init__(self, registry: IndexRegistry | None = None, *,
+                 max_batch: int = 64, max_wait_us: float = 1000.0,
+                 max_queue_rows: int = 4096,
+                 default_timeout_s: float | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 start_workers: bool = True):
+        self.buckets = bucket_sizes(max_batch)
+        self.registry = registry or IndexRegistry(buckets=self.buckets,
+                                                  clock=clock)
+        # an externally-built registry must warm every shape this service's
+        # streams flush, or publish()'s zero-cold-compile swap guarantee is
+        # silently void
+        expects(set(self.buckets) <= set(self.registry.buckets),
+                "registry buckets %s do not cover the service ladder %s",
+                self.registry.buckets, self.buckets)
+        self.max_batch = int(max_batch)
+        self.max_wait_us = float(max_wait_us)
+        self.max_queue_rows = int(max_queue_rows)
+        # a bound below max_batch would refuse every full-bucket request
+        # forever, even on an idle service — a config error, not overload
+        expects(self.max_queue_rows >= self.max_batch,
+                "max_queue_rows (%d) must be >= max_batch (%d)",
+                self.max_queue_rows, self.max_batch)
+        self._rows = _RowCounter(max_queue_rows)  # O(1) admission bound
+        self.default_timeout_s = default_timeout_s
+        self._clock = clock
+        self._start_workers = start_workers
+        # guards the batcher map + the closed flag; admission uses the
+        # leaf-locked _RowCounter instead, so submit never holds this lock
+        # across an enqueue
+        self._lock = threading.Lock()
+        self._batchers: dict[tuple, MicroBatcher] = {}
+        self._closed = False
+
+    # -- publish ------------------------------------------------------------
+    def publish(self, name: str, index, *, search_params=None,
+                k: int | tuple = 10, version: int | None = None,
+                warm: bool = True) -> dict:
+        """Publish/hot-swap through the service's registry, warming against
+        the SERVICE's bucket ladder (the shapes its streams actually flush).
+        Safe under load: in-flight requests finish on the old version."""
+        with tracing.range("serve/publish/%s", name):
+            return self.registry.publish(
+                name, index, search_params=search_params, k=k,
+                version=version, warm=warm)
+
+    # -- serving ------------------------------------------------------------
+    def _stream(self, name: str, k: int) -> MicroBatcher:
+        key = (name, int(k))
+        with self._lock:
+            # re-checked under the lock: a submit racing shutdown() must not
+            # create a batcher shutdown will never close
+            if self._closed:
+                raise ServiceClosedError("service is shut down")
+            b = self._batchers.get(key)
+            if b is None:
+                b = MicroBatcher(
+                    self._make_flush(name, int(k)),
+                    max_batch=self.max_batch, max_wait_us=self.max_wait_us,
+                    clock=self._clock, stream=f"{name}.k{k}",
+                    start=self._start_workers, on_dequeue=self._rows.sub)
+                self._batchers[key] = b
+        return b
+
+    def _make_flush(self, name: str, k: int):
+        def flush(padded_queries):
+            import jax
+
+            with self.registry.lease(name) as v:
+                out = v.searcher(padded_queries, k)
+                # materialize before scattering: a future that resolves is a
+                # result the caller can use at memcpy cost, and the latency
+                # histograms measure real work, not async dispatch
+                jax.block_until_ready(out)
+            return out
+
+        return flush
+
+    def submit(self, name: str, queries, k: int = 10, *,
+               timeout_s: float | None = None) -> Future:
+        """Enqueue a ``(rows, d)`` query block (rows <= ``max_batch``) for
+        index ``name`` at width ``k``; returns a Future resolving to
+        ``(distances (rows, k), ids (rows, k))``.
+
+        Fast-fail admission: raises :class:`ServiceClosedError` after
+        shutdown, :class:`OverloadedError` at the queue bound, and
+        :class:`DeadlineExceededError` when ``timeout_s <= 0``. A queued
+        request whose deadline passes before it is drained fails its future
+        with :class:`DeadlineExceededError` without touching the device.
+
+        Queries are staged as host NumPy (submit never touches the device;
+        the flush dispatches one padded bucket-shaped array) and results
+        resolve to host NumPy arrays — the serving contract is materialized
+        results, not async device handles.
+        """
+        import numpy as np
+
+        if self._closed:
+            raise ServiceClosedError("service is shut down")
+        # lease for the validation reads: a concurrent publish may retire
+        # the version (nulling its searcher) the instant it is unleased
+        with self.registry.lease(name) as v:  # raises for unknown names
+            dim, qdtype, ks = v.searcher.dim, v.searcher.query_dtype, v.ks
+        # only published widths are served: k is a static jit argument, so
+        # an unwarmed k would cold-compile every bucket ON the hot path
+        # (and leak a worker thread per stray k) — the zero-cold-compile
+        # property this layer exists for. Publish with k=(10, 5, ...) to
+        # serve several widths.
+        expects(int(k) in ks,
+                "k=%d was not published for %r (published widths: %s)",
+                k, name, ks)
+        q = np.asarray(queries)
+        expects(q.ndim == 2, "queries must be (rows, d); got ndim=%d", q.ndim)
+        expects(q.shape[1] == dim,
+                "query dim %d != index dim %d", q.shape[1], dim)
+        if qdtype == "float32":
+            q = np.asarray(q, np.float32)
+        else:
+            expects(str(q.dtype) == qdtype,
+                    "byte index %r serves %s queries, got %s", name,
+                    qdtype, str(q.dtype))
+        n = int(q.shape[0])
+        timeout_s = (self.default_timeout_s if timeout_s is None
+                     else timeout_s)
+        deadline = None
+        if timeout_s is not None:
+            if timeout_s <= 0:
+                if metrics._enabled:
+                    _deadline_total().inc(1, stream=f"{name}.k{k}")
+                raise DeadlineExceededError("timeout_s <= 0 at submit")
+            deadline = self._clock() + timeout_s
+        b = self._stream(name, k)  # re-checks _closed under the lock
+        # atomic bounded reservation — the bound is a hard invariant, not a
+        # hint, and it is O(1) regardless of how many streams are live;
+        # the batcher's on_dequeue callback releases rows at drain
+        if not self._rows.try_add(n):
+            if metrics._enabled:
+                _overload_total().inc(1, name=name)
+            raise OverloadedError(
+                f"queue at {self._rows.value()}/{self.max_queue_rows} rows; "
+                f"request of {n} refused")
+        try:
+            fut = b.submit(q, deadline=deadline)
+        except BaseException:  # closed/shape refusal: release the rows
+            self._rows.sub(n)
+            raise
+        if metrics._enabled:
+            _requests_total().inc(1, stream=f"{name}.k{k}")
+        return fut
+
+    def search(self, name: str, queries, k: int = 10, *,
+               timeout_s: float | None = None):
+        """Blocking convenience: :meth:`submit` + ``Future.result()``.
+        Requires running workers (``start_workers=True``); deterministic
+        tests use :meth:`submit` + :meth:`pump` instead."""
+        expects(self._start_workers,
+                "search() blocks on the worker thread; with "
+                "start_workers=False use submit() + pump()")
+        return self.submit(name, queries, k, timeout_s=timeout_s).result()
+
+    # -- test / drain hooks -------------------------------------------------
+    def pump(self, *, force: bool = False) -> int:
+        """Drain-and-flush every stream once, synchronously; returns total
+        rows flushed. The deterministic substitute for the worker threads."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+        return sum(b.pump(force=force) for b in batchers)
+
+    def queue_depth(self) -> int:
+        return self._rows.value()
+
+    # -- shutdown -----------------------------------------------------------
+    def shutdown(self, *, drain: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop the service. New submits fail fast with
+        :class:`ServiceClosedError`; ``drain=True`` completes everything
+        already queued (each pending future resolves normally),
+        ``drain=False`` fails pending futures with
+        :class:`ServiceClosedError`. Idempotent."""
+        self._closed = True
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.close(drain=drain, timeout_s=timeout_s)

@@ -156,3 +156,52 @@ def test_ivf_pq_i8_row_smoke(monkeypatch):
     assert row["name"] == "ivf_pq_1m_i8" and "error" not in row, rows
     assert row["recall"] > 0.7, row
     assert row["i8_over_f32"] is None  # no f32 LID row in this smoke
+
+
+def test_serve_row_smoke(monkeypatch):
+    """The --serve bench row (ISSUE 3 acceptance measurement) must produce
+    a full row — qps, ratio, latency percentiles, occupancy, and the
+    zero-loss zero-cold-compile swap proof — not a guarded error row.
+    Shrunk shapes; the real protocol runs on the TPU driver."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_serve(rows, n=3000, d=32, n_lists=16, pq_dim=16, k=5,
+                     n_probes=16, threads=3, per_thread=30, seq_queries=24,
+                     max_batch=8, max_wait_us=500.0, ncl=32)
+    row = rows[-1]
+    assert row["name"] == "serve_ivf_pq_100k" and "error" not in row, rows
+    assert row["swap"]["failed"] == 0, row
+    assert row["swap"]["version"] == 2, row
+    # the swap window must not cold-compile: every serving program was
+    # warmed at publish and the rebuilt index is HLO-identical
+    assert row["swap"]["compile_s"] == 0.0, row
+    assert row["swap"]["cache_misses"] == 0, row
+    assert row["qps"] > 0 and row["seq_qps"] > 0, row
+    assert row["p99_ms"] >= row["p50_ms"] > 0, row
+    assert 0 < row["mean_batch_occupancy"] <= 1.0, row
+    assert row["recall"] > 0.5, row
+
+
+def test_serve_flag_runs_only_the_serve_row(monkeypatch):
+    """`bench.py --serve` is the parameter-iteration loop: setup + the serve
+    row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_serve",
+        lambda rows: rows.append({"name": "serve_ivf_pq_100k", "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--serve"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "serve_ivf_pq_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()

@@ -1,0 +1,483 @@
+"""Serving-layer tests (tier-1 ``serve`` marker).
+
+Deterministic by construction: the service/batcher take an injected clock
+and run with ``start_workers=False``, driven by ``pump()`` — queue policy
+(deadlines, buckets, occupancy, overload) is asserted without a single
+wall-clock sleep. The two concurrency tests (hot-swap under load, worker
+liveness) use real threads but synchronize on futures/joins, never sleeps.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core.errors import RaftError
+from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+from raft_tpu.serve import (DeadlineExceededError, IndexRegistry,
+                            MicroBatcher, OverloadedError, SearchService,
+                            ServiceClosedError, bucket_for, bucket_sizes)
+
+pytestmark = pytest.mark.serve
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def dataset(rng):
+    return rng.standard_normal((512, 16)).astype(np.float32)
+
+
+@pytest.fixture
+def bf(dataset):
+    return brute_force.BruteForce().build(dataset)
+
+
+def det_service(bf_index, clock, *, max_batch=8, max_wait_us=1000.0,
+                max_queue_rows=32, warm=False, **kw):
+    """A deterministic service: injected clock, no worker threads."""
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max_queue_rows, clock=clock,
+                        start_workers=False, **kw)
+    svc.publish("main", bf_index, k=5, warm=warm)
+    return svc
+
+
+# -- bucket ladder ----------------------------------------------------------
+
+def test_bucket_ladder():
+    assert bucket_sizes(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_sizes(1) == (1,)
+    with pytest.raises(RaftError):
+        bucket_sizes(48)  # not a power of two
+    assert [bucket_for(n, 64) for n in (1, 2, 3, 5, 33, 64)] == \
+        [1, 2, 4, 8, 64, 64]
+
+
+# -- batching semantics -----------------------------------------------------
+
+def test_single_row_flushes_after_max_wait(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_wait_us=1000.0)
+    fut = svc.submit("main", dataset[:1], 5)
+    # deadline not reached: pump() must NOT flush (the request is waiting
+    # for companions)
+    assert svc.pump() == 0 and not fut.done()
+    clock.advance(0.0011)  # past max_wait_us
+    assert svc.pump() == 1
+    d, i = fut.result(timeout=0)
+    assert d.shape == (1, 5) and int(np.asarray(i)[0, 0]) == 0
+
+
+def test_exactly_max_batch_flushes_immediately(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=8)
+    futs = [svc.submit("main", dataset[j:j + 1], 5) for j in range(8)]
+    # queue holds exactly max_batch rows -> ready with NO clock advance
+    assert svc.pump() == 8
+    assert all(f.done() for f in futs)
+    # full bucket: occupancy 1.0, no padding
+    from raft_tpu import obs
+
+    assert obs.quantile("raft_tpu_serve_batch_occupancy", 0.5,
+                        stream="main.k5") == pytest.approx(1.0, abs=0.26)
+
+
+def test_scatter_matches_unbatched_results(bf, dataset):
+    """Rows batched together must get exactly the rows they submitted —
+    the scatter is the correctness core of the batcher."""
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=16)
+    blocks = [dataset[0:3], dataset[3:4], dataset[4:9], dataset[9:16]]
+    futs = [svc.submit("main", b, 5) for b in blocks]
+    assert svc.pump() == 16
+    ref_d, ref_i = bf.search(jnp.asarray(dataset[:16]), 5)
+    off = 0
+    for b, f in zip(blocks, futs):
+        d, i = f.result(timeout=0)
+        np.testing.assert_array_equal(np.asarray(i),
+                                      np.asarray(ref_i)[off:off + len(b)])
+        np.testing.assert_allclose(np.asarray(d),
+                                   np.asarray(ref_d)[off:off + len(b)],
+                                   rtol=1e-5)
+        off += len(b)
+
+
+def test_partial_batch_pads_to_bucket(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=8)
+    fut = svc.submit("main", dataset[:3], 5)
+    clock.advance(0.01)
+    assert svc.pump() == 3  # 3 valid rows -> bucket 4, padded
+    d, _ = fut.result(timeout=0)
+    assert d.shape == (3, 5)
+    from raft_tpu import obs
+
+    # occupancy 3/4 recorded for the padded flush
+    q = obs.quantile("raft_tpu_serve_batch_occupancy", 0.5, stream="main.k5")
+    assert 0.5 < q <= 1.0
+
+
+def test_oversized_request_refused(bf, dataset):
+    svc = det_service(bf, FakeClock(), max_batch=4)
+    with pytest.raises(RaftError):
+        svc.submit("main", dataset[:5], 5)
+
+
+# -- deadlines --------------------------------------------------------------
+
+def test_deadline_expiry_mid_queue_drops_before_batching(bf, dataset):
+    """The expired request must be dropped at drain WITHOUT reaching the
+    searcher, and its queue-mates must still be served."""
+    calls = []
+
+    def spy(queries, k):
+        calls.append(int(queries.shape[0]))
+        return bf.search(queries, k)
+
+    spy.kind, spy.dim, spy.query_dtype = "spy", 16, "float32"
+    clock = FakeClock()
+    svc = SearchService(max_batch=8, max_wait_us=100.0, clock=clock,
+                        start_workers=False)
+    svc.publish("main", spy, k=5, warm=False)
+    f_dead = svc.submit("main", dataset[:2], 5, timeout_s=0.005)
+    f_live = svc.submit("main", dataset[2:3], 5)  # no deadline
+    clock.advance(0.01)  # past both max_wait and f_dead's deadline
+    assert svc.pump() == 1  # only the live row flushed
+    with pytest.raises(DeadlineExceededError):
+        f_dead.result(timeout=0)
+    assert f_live.result(timeout=0)[0].shape == (1, 5)
+    # the expired rows never hit the device: one flush, bucket 1
+    assert calls == [1]
+
+
+def test_submit_with_expired_timeout_fast_fails(bf, dataset):
+    svc = det_service(bf, FakeClock())
+    with pytest.raises(DeadlineExceededError):
+        svc.submit("main", dataset[:1], 5, timeout_s=0.0)
+    assert svc.queue_depth() == 0  # nothing was enqueued
+
+
+# -- admission control ------------------------------------------------------
+
+def test_overload_fast_fail(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=4, max_queue_rows=6)
+    for j in range(6):
+        svc.submit("main", dataset[j:j + 1], 5)
+    with pytest.raises(OverloadedError):
+        svc.submit("main", dataset[:1], 5)
+    # a multi-row request crossing the bound is refused too
+    svc2 = det_service(bf, clock, max_batch=4, max_queue_rows=6)
+    svc2.submit("main", dataset[:4], 5)
+    with pytest.raises(OverloadedError):
+        svc2.submit("main", dataset[:3], 5)
+    # draining reopens admission
+    assert svc.pump(force=True) > 0
+    while svc.pump(force=True):
+        pass
+    svc.submit("main", dataset[:1], 5)  # admitted again
+
+
+def test_unknown_name_rejected(bf, dataset):
+    svc = det_service(bf, FakeClock())
+    with pytest.raises(RaftError):
+        svc.submit("nope", dataset[:1], 5)
+
+
+# -- shutdown ---------------------------------------------------------------
+
+def test_shutdown_with_nonempty_queue_drains(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=8)
+    futs = [svc.submit("main", dataset[j:j + 1], 5) for j in range(3)]
+    svc.shutdown(drain=True)
+    for f in futs:
+        assert f.result(timeout=0)[0].shape == (1, 5)
+    with pytest.raises(ServiceClosedError):
+        svc.submit("main", dataset[:1], 5)
+
+
+def test_shutdown_without_drain_fails_pending(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock)
+    futs = [svc.submit("main", dataset[j:j + 1], 5) for j in range(3)]
+    svc.shutdown(drain=False)
+    for f in futs:
+        with pytest.raises(ServiceClosedError):
+            f.result(timeout=0)
+    assert svc.queue_depth() == 0
+
+
+# -- registry / hot-swap ----------------------------------------------------
+
+def test_publish_warms_every_bucket(bf):
+    reg = IndexRegistry(buckets=(1, 2, 4))
+    rep = reg.publish("main", bf, k=(5, 3))
+    assert rep["version"] == 1
+    for kk in (5, 3):
+        assert sorted(rep["warm"][kk]) == [1, 2, 4]
+        for phase in rep["warm"][kk].values():
+            assert phase["wall_s"] >= 0.0 and "compile_s" in phase
+    # a re-publish of a same-shape index finds every program warm: the jit
+    # cache keys on HLO, and the fresh index matches it bucket for bucket
+    bf2 = brute_force.BruteForce().build(np.asarray(bf.dataset)[::-1].copy())
+    rep2 = reg.publish("main", bf2, k=(5, 3))
+    assert rep2["version"] == 2
+    for kk in (5, 3):
+        for phase in rep2["warm"][kk].values():
+            assert phase["compile_s"] == 0.0 and phase["cache_misses"] == 0
+
+
+def test_swap_retires_old_version_after_lease_drain(bf, dataset):
+    reg = IndexRegistry(buckets=(1,))
+    reg.publish("main", bf, k=5, warm=False)
+    v1 = reg.active("main")
+    with reg.lease("main") as leased:
+        assert leased is v1
+        bf2 = brute_force.BruteForce().build(dataset)
+        reg.publish("main", bf2, k=5, warm=False)
+        # v1 still leased: both versions live
+        assert reg.live_versions("main") == (1, 2)
+        assert leased.searcher is not None  # usable mid-swap
+    # lease released -> v1 retired, arrays droppable
+    assert reg.live_versions("main") == (2,)
+    assert v1.searcher is None
+
+
+def test_version_numbers_monotonic(bf):
+    reg = IndexRegistry(buckets=(1,))
+    reg.publish("main", bf, warm=False)
+    reg.publish("main", bf, warm=False, version=7)
+    with pytest.raises(RaftError):
+        reg.publish("main", bf, warm=False, version=3)
+    assert reg.active("main").version == 7
+
+
+def test_hot_swap_under_concurrent_load_loses_nothing(bf, dataset):
+    """The acceptance-critical property: a publish landing mid-load must
+    not fail a single in-flight or queued request. Real worker + submitter
+    threads; synchronization via futures only."""
+    svc = SearchService(max_batch=8, max_wait_us=200.0, max_queue_rows=512)
+    svc.publish("main", bf, k=5, warm=True)
+    n_req, errors, done = 120, [], []
+    lock = threading.Lock()
+
+    def submitter(tid):
+        for j in range(n_req // 4):
+            try:
+                d, i = svc.search("main", dataset[(tid * 31 + j) % 500:
+                                                 (tid * 31 + j) % 500 + 1], 5)
+                with lock:
+                    done.append(int(np.asarray(i)[0, 0]))
+            except Exception as e:  # any failure is a test failure
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=submitter, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    # two swaps while the load is in flight
+    for _ in range(2):
+        bf2 = brute_force.BruteForce().build(dataset)
+        svc.publish("main", bf2, k=5)
+    for t in threads:
+        t.join(60)
+        assert not t.is_alive(), "submitter wedged"
+    svc.shutdown()
+    assert errors == []
+    assert len(done) == n_req
+    # old versions drained and retired; only the last survives
+    assert len(svc.registry.live_versions("main")) == 1
+
+
+# -- all four index kinds through the registry ------------------------------
+
+def test_all_index_kinds_publishable(dataset):
+    reg = IndexRegistry(buckets=(1, 2))
+    x = jnp.asarray(dataset)
+    idxs = {
+        "bf": brute_force.BruteForce().build(x),
+        "flat": ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x),
+        "pq": ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_bits=4, pq_dim=8, seed=0), x),
+        "cagra": cagra.build(cagra.IndexParams(seed=0), x),
+    }
+    params = {"flat": ivf_flat.SearchParams(n_probes=8),
+              "pq": ivf_pq.SearchParams(n_probes=8),
+              "cagra": cagra.SearchParams(itopk_size=32)}
+    for name, idx in idxs.items():
+        rep = reg.publish(name, idx, search_params=params.get(name), k=4)
+        assert rep["version"] == 1 and 1 in rep["warm"][4]
+        with reg.lease(name) as v:
+            d, i = v.searcher(x[:2], 4)
+            assert d.shape == (2, 4) and i.shape == (2, 4)
+
+
+def test_byte_index_serves_byte_queries(rng):
+    """int8 datasets publish + serve through the same path (the PR 1 byte
+    pipeline): warmup draws int8 queries, submit enforces the dtype."""
+    xb = rng.integers(-128, 128, (256, 16), dtype=np.int8)
+    idx = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=4, list_dtype="int8", seed=0), xb)
+    assert idx.data_kind == "int8"
+    clock = FakeClock()
+    svc = SearchService(max_batch=2, clock=clock, start_workers=False)
+    rep = svc.publish("bytes", idx,
+                      search_params=ivf_flat.SearchParams(n_probes=4), k=3)
+    assert 1 in rep["warm"][3]
+    fut = svc.submit("bytes", xb[:1], 3)
+    clock.advance(1.0)
+    assert svc.pump() == 1
+    assert fut.result(timeout=0)[1].shape == (1, 3)
+    with pytest.raises(RaftError):  # f32 queries against a byte index
+        svc.submit("bytes", np.zeros((1, 16), np.float32), 3)
+
+
+# -- direct batcher edge cases ----------------------------------------------
+
+def test_batcher_flush_error_fails_whole_batch(dataset):
+    def boom(q):
+        raise ValueError("kernel exploded")
+
+    clock = FakeClock()
+    b = MicroBatcher(boom, max_batch=4, clock=clock, start=False)
+    futs = [b.submit(jnp.asarray(dataset[:1])) for _ in range(2)]
+    clock.advance(1.0)
+    b.pump()
+    for f in futs:
+        with pytest.raises(ValueError):
+            f.result(timeout=0)
+
+
+def test_batcher_worker_thread_flushes(bf, dataset):
+    """Liveness of the real worker: a submitted row completes without any
+    pump() call. Bounded by the future's own timeout, not a sleep."""
+    b = MicroBatcher(lambda q: bf.search(q, 5), max_batch=4,
+                     max_wait_us=500.0, start=True)
+    fut = b.submit(jnp.asarray(dataset[:1]))
+    d, i = fut.result(timeout=30)
+    assert d.shape == (1, 5)
+    b.close()
+
+
+def test_metrics_catalogue(bf, dataset):
+    """The serve metric names the docs promise exist and move."""
+    from raft_tpu import obs
+
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=4, max_queue_rows=4, warm=True)
+    svc.submit("main", dataset[:1], 5)
+    clock.advance(1.0)
+    svc.pump()
+    for j in range(4):
+        svc.submit("main", dataset[j:j + 1], 5)
+    with pytest.raises(OverloadedError):
+        svc.submit("main", dataset[:1], 5)
+    js = obs.to_json()
+    for needed in (
+            'raft_tpu_serve_queue_depth{stream="main.k5"}',
+            'raft_tpu_serve_wait_seconds_count{stream="main.k5"}',
+            'raft_tpu_serve_batch_occupancy_count{stream="main.k5"}',
+            'raft_tpu_serve_flush_total{bucket="1",stream="main.k5"}',
+            'raft_tpu_serve_overload_total{name="main"}',
+            'raft_tpu_serve_requests_total{stream="main.k5"}',
+            'raft_tpu_serve_versions_live{name="main"}'):
+        assert needed in js, f"missing {needed}"
+    svc.shutdown(drain=True)
+
+
+def test_cancelled_future_dropped_not_crashing(bf, dataset):
+    """A caller cancelling a queued future must not crash the flush (which
+    would kill the worker and strand the rest of the batch): the cancelled
+    request is dropped at drain, its batch-mates are served."""
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=8)
+    f_cancel = svc.submit("main", dataset[:2], 5)
+    f_live = svc.submit("main", dataset[2:3], 5)
+    assert f_cancel.cancel()
+    clock.advance(0.01)
+    assert svc.pump() == 1  # only the live row reached the device
+    assert f_live.result(timeout=0)[0].shape == (1, 5)
+    assert svc.queue_depth() == 0
+
+
+def test_external_registry_must_cover_service_buckets(bf):
+    reg = IndexRegistry(buckets=(1, 2, 4))
+    with pytest.raises(RaftError):
+        SearchService(reg, max_batch=8)  # ladder up to 8 not covered
+    SearchService(reg, max_batch=4).shutdown()  # exact cover is fine
+
+
+def test_publish_hook_with_search_params_refused(bf):
+    from raft_tpu.neighbors import brute_force as bfm
+
+    reg = IndexRegistry(buckets=(1,))
+    hook = bfm.batched_searcher(bf)
+    with pytest.raises(RaftError):
+        reg.publish("main", hook, search_params=object(), warm=False)
+
+
+def test_deadline_shorter_than_batching_budget_fails_promptly(bf, dataset):
+    """A deadline tighter than max_wait_us must make the stream ready at
+    the deadline, not at the batching budget — the caller's future fails
+    ~when its deadline passes."""
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_wait_us=100_000.0)  # 100 ms budget
+    fut = svc.submit("main", dataset[:1], 5, timeout_s=0.005)
+    clock.advance(0.006)  # past the deadline, far before max_wait
+    assert svc.pump() == 0  # ready fired for the expiry, nothing flushed
+    with pytest.raises(DeadlineExceededError):
+        fut.result(timeout=0)
+
+
+def test_contract_changing_republish_refused(bf, dataset, rng):
+    """A dim- or dtype-changing republish under a live name would wedge the
+    pinned streams; publish must refuse it before spending warmup time."""
+    reg = IndexRegistry(buckets=(1,))
+    reg.publish("main", bf, k=5, warm=False)
+    wide = brute_force.BruteForce().build(
+        rng.standard_normal((64, 32)).astype(np.float32))
+    with pytest.raises(RaftError):
+        reg.publish("main", wide, k=5, warm=False)  # 16 -> 32 dims
+    assert reg.active("main").version == 1  # flip never happened
+
+
+def test_unpublished_k_refused(bf, dataset):
+    """k is a static jit arg: serving an unwarmed width would cold-compile
+    on the hot path, so submit refuses widths publish() did not warm."""
+    clock = FakeClock()
+    svc = SearchService(max_batch=2, clock=clock, start_workers=False)
+    svc.publish("main", bf, k=(5, 3), warm=False)
+    svc.submit("main", dataset[:1], 3)  # published width: admitted
+    with pytest.raises(RaftError):
+        svc.submit("main", dataset[:1], 7)
+    assert svc.queue_depth() == 1  # the refusal did not consume the bound
+
+
+def test_expired_deadline_does_not_early_flush_queue_mates(bf, dataset):
+    """One tight-deadline client must not degrade batching for everyone:
+    sweeping its expired request leaves fresh queue-mates queued until the
+    normal flush condition (max_batch / max_wait) holds."""
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=8, max_wait_us=100_000.0)
+    f_live = svc.submit("main", dataset[:1], 5)  # no deadline
+    f_dead = svc.submit("main", dataset[1:2], 5, timeout_s=0.005)
+    clock.advance(0.006)  # deadline passed, batching budget (100ms) not
+    assert svc.pump() == 0  # expired swept, NOTHING flushed early
+    with pytest.raises(DeadlineExceededError):
+        f_dead.result(timeout=0)
+    assert not f_live.done() and svc.queue_depth() == 1
+    clock.advance(0.1)  # now the batching budget expires
+    assert svc.pump() == 1
+    assert f_live.result(timeout=0)[0].shape == (1, 5)
