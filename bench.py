@@ -743,6 +743,53 @@ def _row_cagra(rows, dataset, qsets, gt):
                  "build_s": round(build_s, 1)})
 
 
+def _render_note(artifact: dict) -> str:
+    """Markdown round-note table generated FROM a BENCH_rXX.json artifact
+    (VERDICT r5 #7: the r05 BASELINE note described a different session than
+    the committed artifact — prose and artifact must be the same bytes).
+    Pure stdlib, no jax: runs anywhere, including the doc-writing host.
+
+        python bench.py --note BENCH_r06.json >> BASELINE.md   # then edit
+
+    Ratio fields that ride IN the rows (fused_over_control, i8_over_f32,
+    serve_over_seq) are printed from the rows, never recomputed elsewhere.
+    """
+    if "parsed" in artifact and isinstance(artifact["parsed"], dict):
+        # driver wrapper ({n, cmd, rc, tail, parsed}): the bench's own
+        # result line lives under "parsed"
+        artifact = artifact["parsed"]
+    lines = [
+        "| row | QPS | recall | build_s | ratio |",
+        "|---|---|---|---|---|",
+    ]
+    for r in artifact.get("rows", []):
+        name = r.get("name", "?")
+        if "error" in r:
+            lines.append(f"| {name} | ERROR | | | {r['error'][:60]} |")
+            continue
+        if "qps" not in r:
+            continue
+        ratio = ""
+        for key, label in (("fused_over_control", "fused/control"),
+                           ("i8_over_f32", "i8/f32"),
+                           ("serve_over_seq", "serve/seq")):
+            if r.get(key) is not None:
+                ratio = f"{label} **{r[key]}**"
+        rec = r.get("recall")
+        lines.append(
+            f"| {name} | {r['qps']:,.1f} | "
+            f"{'' if rec is None else format(rec, '.4f')} | "
+            f"{r.get('build_s', '')} | {ratio} |")
+    head = (
+        f"Flagship {artifact.get('value', 0):,.1f} {artifact.get('unit', '')}"
+        f" (vs_baseline {artifact.get('vs_baseline')}), "
+        f"elapsed {artifact.get('elapsed_s')}s, "
+        f"metrics_enabled={artifact.get('metrics_enabled')}. "
+        "Table generated by `python bench.py --note <artifact>` — the "
+        "numbers below ARE the artifact's.")
+    return head + "\n\n" + "\n".join(lines)
+
+
 def _backend_or_exit(rows, timeout_s=150.0):
     """Force backend init under a watchdog, emitting + exiting 0 on failure.
 
@@ -912,6 +959,13 @@ def main(argv=None):
 
     rows = _STATE["rows"]
     argv = sys.argv[1:] if argv is None else argv
+    if "--note" in argv:
+        # render a round-note table from a committed artifact and exit —
+        # never touches jax, so it cannot fail on a broken backend
+        path = argv[argv.index("--note") + 1]
+        with open(path) as f:
+            print(_render_note(json.load(f)))
+        return 0
     if "--no-metrics" in argv:
         # the disabled-path proof: every obs touch point reduces to one
         # module-flag check and rows carry no "obs" attribution field

@@ -1,0 +1,129 @@
+"""Interleaved A/B of the CAGRA build-chunk candidate select: wide-k Pallas
+selector vs lax.top_k, at the EXACT call site it was commissioned for
+(VERDICT r4 #5 / r5 #3 — `cagra.py _build_chunk_step` → `ivf_pq.search`'s
+k = gpu_top_k + 1 = 193 per-chunk + final-merge selects).
+
+Two measurements, one process:
+
+1. ``chunk``: the full `_build_chunk_step` (PQ search + exact refine +
+   self-edge drop — the program the 1M build dispatches ~62 times) with
+   select_impl in {"xla", "pallas"}. The r04 selection-share probe bounded
+   selection at ~8% of the chunk, so the expected delta is small — this is
+   the commissioned proof either way.
+2. ``select``: the bare ivf_pq.search at the same shapes, isolating the
+   select from the refine so the per-select ratio is readable, ACROSS a
+   column-width sweep (the per-chunk width probe_chunk*capacity is ~10-40k
+   cols — BELOW the 65536-col threshold the r05 study measured at, so this
+   sweep is the data that decides whether the auto wide-k threshold drops).
+
+Run on the TPU host:
+
+    python bench/cagra_build_select_ab.py [--n 1000000] [--rounds 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--chunk", type=int, default=16384)
+    args = ap.parse_args()
+
+    from raft_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench as drv
+    from raft_tpu.core.resources import default_resources
+    from raft_tpu.distance.types import resolve_metric
+    from raft_tpu.neighbors import cagra, ivf_pq
+
+    print(f"backend: {jax.default_backend()}", file=sys.stderr)
+    dataset, _ = (drv._make_1m() if args.n >= 1_000_000 else
+                  drv._make_clustered(args.n, 128, 1000,
+                                      max(args.n // 500, 8)))
+    x = jnp.asarray(dataset)
+    jax.block_until_ready(x)
+    n, d = x.shape
+
+    params = cagra.IndexParams()
+    k, gpu_top_k, n_lists, pq_bits = cagra.knn_build_plan(params, n, d)
+    res = default_resources()
+    t0 = time.perf_counter()
+    pq = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=n_lists, metric=params.metric,
+                           pq_bits=pq_bits, seed=params.seed), x)
+    jax.block_until_ready(pq.list_codes)
+    print(f"ivf_pq build {time.perf_counter() - t0:.1f}s "
+          f"(n_lists={n_lists}, capacity={pq.capacity}, "
+          f"select k={gpu_top_k + 1})", file=sys.stderr)
+    mt = resolve_metric(params.metric)
+    chunk = args.chunk
+    xb = x[:chunk]
+    rows = jnp.arange(chunk, dtype=jnp.int32)
+
+    # --- 1. full build-chunk A/B (the commissioned measurement) ---
+    impls = ("xla", "pallas", "auto")
+    outs = {}
+    for impl in impls:
+        t0 = time.perf_counter()
+        out = cagra._build_chunk_step(x, pq, xb, rows, 32, int(gpu_top_k),
+                                      int(k), mt, int(res.workspace_bytes),
+                                      impl)
+        np.asarray(out)
+        outs[impl] = out
+        print(f"chunk[{impl}] compile+run {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+    # identical edge lists = the routing changed nothing but the selector
+    for impl in impls[1:]:
+        same = float(np.mean(np.asarray(outs[impl]) == np.asarray(outs["xla"])))
+        print(f"chunk[{impl}] edge agreement vs xla: {same:.4f}")
+    times = {impl: [] for impl in impls}
+    for r in range(args.rounds):
+        for impl in impls:
+            t0 = time.perf_counter()
+            np.asarray(cagra._build_chunk_step(
+                x, pq, xb, rows, 32, int(gpu_top_k), int(k), mt,
+                int(res.workspace_bytes), impl))
+            times[impl].append(time.perf_counter() - t0)
+    for impl in impls:
+        best = min(times[impl])
+        print(f"chunk[{impl}] best {best:.3f}s "
+              f"({chunk / best:,.0f} rows/s)  all "
+              f"{[f'{t:.2f}' for t in times[impl]]}")
+    print(f"chunk pallas/xla speedup: "
+          f"{min(times['xla']) / min(times['pallas']):.3f}x")
+
+    # --- 2. bare select sweep: the per-select ratio vs column width ---
+    for n_probes in (8, 16, 32):
+        sps = {impl: ivf_pq.SearchParams(n_probes=n_probes, select_impl=impl)
+               for impl in ("xla", "pallas")}
+        for impl, sp in sps.items():
+            np.asarray(ivf_pq.search(sp, pq, xb, gpu_top_k + 1)[1])  # warm
+        best = {}
+        for impl, sp in sps.items():
+            bt = float("inf")
+            for r in range(args.rounds):
+                t0 = time.perf_counter()
+                np.asarray(ivf_pq.search(sp, pq, xb, gpu_top_k + 1)[1])
+                bt = min(bt, time.perf_counter() - t0)
+            best[impl] = bt
+        print(f"search p={n_probes:2d} (<= {n_probes * pq.capacity} cols) "
+              f"xla {best['xla']:.3f}s pallas {best['pallas']:.3f}s "
+              f"ratio {best['xla'] / best['pallas']:.3f}x")
+
+
+if __name__ == "__main__":
+    main()

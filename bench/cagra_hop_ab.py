@@ -51,6 +51,13 @@ def main():
     variants = {
         "xla": cagra.SearchParams(itopk_size=args.itopk, hop_impl="xla"),
         "fused": cagra.SearchParams(itopk_size=args.itopk, hop_impl="fused"),
+        # r06 arena (register-carried gate, value-carried candidate pool)
+        # vs the r05 arena (SMEM handshake + scratch pool) — the A/B that
+        # prices the named ~5 us/query residual (VERDICT r5 #4)
+        "arena": cagra.SearchParams(itopk_size=args.itopk,
+                                    hop_impl="fused_arena"),
+        "arena_smem": cagra.SearchParams(itopk_size=args.itopk,
+                                         hop_impl="fused_arena_smem"),
     }
     outs = {}
     for name, sp in variants.items():
@@ -75,9 +82,10 @@ def main():
         qps = times[name]
         print(f"{name:6s} recall {rec:.4f}  QPS "
               f"{[f'{v/1e3:.1f}k' for v in qps]}")
-    sp_ratio = [f / x for f, x in zip(times["fused"], times["xla"])]
-    print(f"fused/xla per round: {[f'{r:.3f}' for r in sp_ratio]}  "
-          f"best-ratio {max(times['fused'])/max(times['xla']):.3f}")
+    for name in ("fused", "arena", "arena_smem"):
+        sp_ratio = [f / x for f, x in zip(times[name], times["xla"])]
+        print(f"{name}/xla per round: {[f'{r:.3f}' for r in sp_ratio]}  "
+              f"best-ratio {max(times[name])/max(times['xla']):.3f}")
 
 
 if __name__ == "__main__":

@@ -7,9 +7,15 @@ Variants (one process, interleaved):
   nodedup    beam-membership masks skipped
   nomerge    dedup+extraction skipped (beam passes through; pick still runs)
   noscore    distance computation skipped (gathers still happen)
+  nogate     arena merges only: insertion loop UNGATED (full-vs-nogate =
+             the threshold gate's measured worth; r06 residual carve)
   gatheronly no kernel at all — the while_loop + two gathers + trivial ops
 
+``--merge`` profiles a specific merge impl (extract | arena | arena_smem) —
+the r06 residual attack carves the ARENA loop, the r05 study carved extract.
+
 Run on the TPU host:  python bench/cagra_hop_profile.py [--rounds 3]
+                      python bench/cagra_hop_profile.py --merge arena
 """
 
 from __future__ import annotations
@@ -27,6 +33,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--itopk", type=int, default=32)
+    ap.add_argument("--merge", default="extract",
+                    choices=["extract", "arena", "arena_smem"])
     args = ap.parse_args()
 
     from raft_tpu.config import enable_compilation_cache
@@ -73,6 +81,8 @@ def main():
         bv = jnp.ones((m, 128), jnp.int32).at[:, :itopk].set(0)
         return qf, bd, bi, bv
 
+    merge = args.merge
+
     @functools.partial(jax.jit, static_argnames=("profile",))
     def run(state, data, graph, profile):
         qf, bd, bi, bv = state
@@ -97,7 +107,8 @@ def main():
         zero_vecs = jnp.zeros((m, deg, d), jnp.float32)
         bd, bi, bv, pick, nocand = cagra_hop(
             qf, bd, bi, bv, zero_nbrs, zero_vecs,
-            jnp.zeros((m, deg), jnp.int32), itopk, width=1, profile=profile)
+            jnp.zeros((m, deg), jnp.int32), itopk, width=1, profile=profile,
+            merge=merge)
 
         def body(state):
             bd, bi, bv, pick, nocand, it = state
@@ -106,7 +117,7 @@ def main():
             valid = jnp.repeat(1 - nocand, deg, axis=1)
             bd, bi, bv, pick, nocand = cagra_hop(
                 qf, bd, bi, bv, nbrs, vecs, valid, itopk, width=1,
-                profile=profile)
+                profile=profile, merge=merge)
             return bd, bi, bv, pick, nocand, it + 1
 
         bd, bi, *_ = lax.while_loop(
@@ -116,6 +127,10 @@ def main():
         return bd[:, :10], bi[:, :10]
 
     variants = ["full", "nodedup", "nomerge", "noscore", "gatheronly"]
+    if merge in ("arena", "arena_smem"):
+        # the arena folds dedup into insertion, so nodedup is meaningless;
+        # nogate prices the threshold gate instead
+        variants = ["full", "nogate", "nomerge", "noscore", "gatheronly"]
     key = jax.random.key(0)
     states = [init_state(qs, key, idx.dataset) for qs in qsets]
     jax.block_until_ready(states)

@@ -1,0 +1,106 @@
+"""Per-remedy recall A/B for IVF-PQ on the heavytail family (VERDICT r5 #2).
+
+Measures the four remedy combinations — per_subspace (the collapsed
+baseline), codebook_kind="per_cluster", residual_scale_norm=True, and both —
+at matched build/search params, reporting bare and refine4 recall@10 plus
+QPS. Recall is hardware-independent, so `--n 100000` on the CPU mesh gives
+the remedy ranking cheaply; the 1M QPS-bearing rows ride
+`bench/ann/conf/heavytail-1M-128.json` (ivf_pq_pq4x64_refine4_scalenorm /
+_percluster) through the usual harness on the TPU host:
+
+    python bench/heavytail_rescue_ab.py [--n 1000000] [--clusters 2000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--clusters", type=int, default=0,
+                    help="0 = scale 2000 with n/1M (keeps rows/cluster)")
+    ap.add_argument("--n-queries", type=int, default=1000)
+    ap.add_argument("--n-lists", type=int, default=0, help="0 = n/1M * 1024")
+    ap.add_argument("--probes", type=int, default=16)
+    args = ap.parse_args()
+
+    from raft_tpu.config import enable_compilation_cache
+
+    enable_compilation_cache()
+    import jax
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.brute_force import knn
+    from raft_tpu.neighbors.refine import refine
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent / "ann"))
+    from run import load_dataset  # the committed heavytail generator
+
+    n = args.n
+    frac = max(n / 1_000_000, 0.01)
+    ncl = args.clusters or max(int(2000 * frac), 8)
+    n_lists = args.n_lists or max(int(1024 * frac), 8)
+    print(f"backend: {jax.default_backend()}  n={n} ncl={ncl} "
+          f"n_lists={n_lists}", file=sys.stderr)
+    spec = {"distance": "euclidean",
+            "synthetic": {"family": "heavytail", "n": n,
+                          "n_queries": args.n_queries, "dim": args.dim,
+                          "clusters": ncl, "zipf": 1.0, "seed": 21}}
+    x, q, _ = load_dataset(spec)
+    import jax.numpy as jnp
+
+    x, q = jnp.asarray(x), jnp.asarray(q)
+    jax.block_until_ready((x, q))
+    _, gt = knn(x, q, 10)
+    gt = np.asarray(gt)
+
+    def recall(ids):
+        return float(np.mean([len(set(ids[r]) & set(gt[r])) / 10
+                              for r in range(gt.shape[0])]))
+
+    rows = []
+    for name, kind, norm in (("per_subspace", "per_subspace", False),
+                             ("per_cluster", "per_cluster", False),
+                             ("scale_norm", "per_subspace", True),
+                             ("per_cluster+scale_norm", "per_cluster", True)):
+        t0 = time.perf_counter()
+        idx = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=n_lists, pq_bits=4, pq_dim=64, codebook_kind=kind,
+            residual_scale_norm=norm, seed=0), x)
+        jax.block_until_ready(idx.list_codes)
+        build_s = time.perf_counter() - t0
+        sp = ivf_pq.SearchParams(n_probes=args.probes, lut_dtype="bfloat16")
+
+        def searcher(qq):
+            _, cand = ivf_pq.search(sp, idx, qq, 40)
+            return refine(x, qq, cand, 10)
+
+        _, ids_bare = ivf_pq.search(sp, idx, q, 10)
+        out = searcher(q)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = searcher(q)
+        jax.block_until_ready(out)
+        qps = q.shape[0] / (time.perf_counter() - t0)
+        row = {"variant": name, "build_s": round(build_s, 1),
+               "bare_recall": round(recall(np.asarray(ids_bare)), 4),
+               "refine4_recall": round(recall(np.asarray(out[1])), 4),
+               "qps": round(qps, 1)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    print(json.dumps({"n": n, "clusters": ncl, "n_lists": n_lists,
+                      "probes": args.probes, "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

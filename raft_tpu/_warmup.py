@@ -36,18 +36,36 @@ __all__ = ["warmup", "warm_buckets"]
 _KINDS = ("brute_force", "ivf_flat", "ivf_pq", "cagra")
 
 
-def _random_queries(key, rows: int, d: int, dtype: str):
+def _random_queries(key, rows: int, d: int, dtype: str, sample=None):
     import jax
     import jax.numpy as jnp
 
+    if sample is not None:
+        # rows resampled (with replacement) from the user's sample: the
+        # warmed programs see the REAL data distribution, not the uniform
+        # worst case
+        return _resample(key, sample, rows)
     if dtype == "float32":
         return jax.random.uniform(key, (rows, d), jnp.float32)
     lo, hi = (-128, 128) if dtype == "int8" else (0, 256)
     return jax.random.randint(key, (rows, d), lo, hi, jnp.int32).astype(dtype)
 
 
+def _resample(key, sample, rows: int):
+    """(rows, d) drawn with replacement from the user's sample rows — the
+    warmup dataset keeps the production data's cluster/clump structure, it
+    just repeats points when the sample is smaller than the target n."""
+    import jax
+    import jax.numpy as jnp
+
+    sample = jnp.asarray(sample)
+    idx = jax.random.randint(key, (rows,), 0, sample.shape[0])
+    return jnp.take(sample, idx, axis=0)
+
+
 def warm_buckets(searcher, *, dim: int, buckets, k: int = 10,
-                 dtype: str = "float32", seed: int = 0) -> dict:
+                 dtype: str = "float32", seed: int = 0,
+                 sample=None) -> dict:
     """Compile-warm one serving searcher at every batch-shape bucket.
 
     The serving-layer half of :func:`warmup` (raft_tpu.serve): a micro-
@@ -60,24 +78,41 @@ def warm_buckets(searcher, *, dim: int, buckets, k: int = 10,
     cache off the serving path (enable the cache first, see
     :func:`raft_tpu.config.enable_compilation_cache`).
 
+    ``sample`` (optional, (r, dim) in the serving query dtype) draws the
+    bucket queries from real data instead of uniform noise. Compilation
+    does not depend on VALUES, so any sample warms the same programs — but
+    data-dependent execution time does (CAGRA's hop loop runs ~3.7x longer
+    on uniform data than clustered, BASELINE.md "Round-6 warmup data
+    sample"), so a sample makes publish-time warms cheaper and their
+    reported walls representative.
+
     Returns ``{bucket: {wall_s, compile_s, trace_s, programs, cache_hits,
     cache_misses}}`` via the obs compile-attribution subscription — all-warm
     buckets report ``compile_s == 0``, which is the zero-hiccup-swap proof
     ``bench.py --serve`` asserts.
     """
     import jax
+    import jax.numpy as jnp
 
     from .core.errors import expects
     from .obs import compile as obs_compile
 
     expects(dtype in ("float32", "int8", "uint8"),
             "dtype must be 'float32', 'int8' or 'uint8', got %r", dtype)
+    if sample is not None:
+        sample = jnp.asarray(sample)
+        expects(sample.ndim == 2 and sample.shape[1] == dim,
+                "warm sample must be (rows, %d), got %s", dim,
+                tuple(sample.shape))
+        expects(str(sample.dtype) == dtype,
+                "warm sample dtype %s must match the serving dtype %s",
+                sample.dtype, dtype)
     out = {}
     key = jax.random.key(seed)
     for b in sorted(set(int(b) for b in buckets)):
         expects(b >= 1, "bucket sizes must be >= 1, got %d", b)
         key, kq = jax.random.split(key)
-        q = _random_queries(kq, b, dim, dtype)
+        q = _random_queries(kq, b, dim, dtype, sample=sample)
         jax.block_until_ready(q)
         t0 = time.perf_counter()
         with obs_compile.attribution() as rec:
@@ -89,7 +124,8 @@ def warm_buckets(searcher, *, dim: int, buckets, k: int = 10,
 
 
 def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
-           dtype: str = "float32", index_params: Any | None = None,
+           dtype: str = "float32", data: Any | None = None,
+           index_params: Any | None = None,
            search_params: Any | None = None, cache_dir: str | None = None,
            seed: int = 0) -> dict:
     """Compile-warm one index kind at (n, d) build / (queries, d) search.
@@ -110,6 +146,17 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
     ``dtype`` ("float32" | "int8" | "uint8") warms the byte-dataset search
     paths: random data is drawn in the target dtype, so the s8 kernels and
     byte list layouts compile exactly as production will run them.
+
+    ``data`` (optional (r, d) array, any r) warms on a SAMPLE OF THE REAL
+    DATA, resampled with replacement to the target (n, d) / (queries, d)
+    shapes. Compiled programs are shape-keyed, so the cache outcome is
+    identical either way — but the warmup's own wall time is not: uniform
+    random data is the measured worst case of the data-adaptive builds
+    (CAGRA's build_n_probes autotune keeps p=32 on uniform data, 483 s vs
+    ~130 s at 1M on clustered — VERDICT r5 #5), so a few thousand rows of
+    production data make a cagra warmup ~3.7x cheaper while warming the
+    exact same programs. When ``data`` is int8/uint8, ``dtype`` must agree
+    (or be left at its default, which is then inferred from the sample).
 
     The returned dict attributes each wall time instead of leaving it opaque
     (obs/compile.py, the jax.monitoring subscription): ``build``/``search``
@@ -134,9 +181,25 @@ def warmup(kind: str, n: int, d: int, *, k: int = 10, queries: int = 10_000,
             ", ".join(_KINDS))
     expects(dtype in ("float32", "int8", "uint8"),
             "dtype must be 'float32', 'int8' or 'uint8', got %r", dtype)
+    sample = None
+    if data is not None:
+        # validate BEFORE the cache redirect below — a bad sample must fail
+        # without permanently re-pointing this process's jax cache config
+        sample = jnp.asarray(data)
+        expects(sample.ndim == 2 and sample.shape[1] == d,
+                "data sample must be (rows, %d), got %s", d,
+                tuple(sample.shape))
+        if str(sample.dtype) in ("int8", "uint8") and dtype == "float32":
+            dtype = str(sample.dtype)  # infer byte kinds from the sample
+        expects(str(sample.dtype) == dtype,
+                "data sample dtype %s must match dtype=%r", sample.dtype,
+                dtype)
     cache = enable_compilation_cache(cache_dir)
     kd, kq = jax.random.split(jax.random.key(seed))
-    if dtype == "float32":
+    if sample is not None:
+        x = _resample(kd, sample, n)
+        q = _resample(kq, sample, queries)
+    elif dtype == "float32":
         x = jax.random.uniform(kd, (n, d), jnp.float32)
         q = jax.random.uniform(kq, (queries, d), jnp.float32)
     else:

@@ -40,7 +40,9 @@ __all__ = [
 #   raft_tpu/4: cagra carries seed_pool_hint (measured search autotune).
 #   raft_tpu/5: ivf_flat carries data_kind (int8/uint8 list storage).
 #   raft_tpu/6: ivf_pq + cagra carry data_kind (int8/uint8 byte datasets).
-SERIALIZATION_VERSION = "raft_tpu/6"
+#   raft_tpu/7: ivf_pq carries list_scales (per-list residual scale
+#       normalization, IndexParams.residual_scale_norm).
+SERIALIZATION_VERSION = "raft_tpu/7"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
@@ -48,10 +50,11 @@ SERIALIZATION_VERSION = "raft_tpu/6"
 # returned version where a field was added).
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                           "raft_tpu/5"}),
-    "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5"}),
+                           "raft_tpu/5", "raft_tpu/6"}),
+    "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
+                         "raft_tpu/6"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                        "raft_tpu/5"}),
+                        "raft_tpu/5", "raft_tpu/6"}),
 }
 
 

@@ -6,7 +6,7 @@ bitonic queues detail/select_warpsort.cuh — picked by a learned heuristic,
 detail/select_k-inl.cuh:46). The TPU mirror of that two-algorithm split:
 XLA's native TopK (`lax.top_k`, a tuned sort) below ~64k columns, and the
 threshold-gated streaming Pallas selector (raft_tpu.ops.topk_pallas, one HBM
-pass) for wide rows with k <= 64. The payload
+pass) for wide rows with k <= 256 (r06 lift). The payload
 (caller-provided source indices, used when merging per-shard candidate lists)
 is carried by gathering with the top-k permutation.
 """
@@ -25,6 +25,77 @@ from ..core.errors import expects
 from ..obs.instrument import instrument, nrows
 
 __all__ = ["select_k"]
+
+# Widest k the TPU streaming selector is dispatched for — MUST equal
+# ops.topk.TOPK_MAX_K (pinned by tests/test_matrix.py::test_select_k_dispatch
+# _cap_matches_kernel_limit so neither can silently drift). History: r05
+# capped dispatch at 128 because two kh=256 kernel instances in one XLA
+# program hit a TPU-internal Mosaic error; r06's half-width merge
+# (ops/topk.py wide_merge="half") keeps every merge intermediate <= kh lanes
+# and lifts the cap to the kernel's full 256. RAFT_TPU_WIDE_SELECT_CAP can
+# re-impose a lower cap at runtime (e.g. =128) if a future toolchain
+# regresses — the escape hatch the repro harness (bench/topk_chain_repro.py)
+# documents.
+SELECT_K_DISPATCH_MAX_K = 256
+
+
+def _dispatch_cap() -> int:
+    # Read at TRACE time: programs already compiled for a shape keep the
+    # dispatch they traced with — apply the escape hatch in a fresh process
+    # (or before the first search of a shape), not mid-flight.
+    import os
+
+    cap = os.environ.get("RAFT_TPU_WIDE_SELECT_CAP")
+    if not cap:
+        return SELECT_K_DISPATCH_MAX_K
+    try:
+        return min(int(cap), SELECT_K_DISPATCH_MAX_K)
+    except ValueError:
+        raise ValueError(
+            f"RAFT_TPU_WIDE_SELECT_CAP must be an integer, got {cap!r}")
+
+
+def wide_dispatch_ok(n: int, k: int, dtype, backend: str | None = None) -> bool:
+    """True when (n, k, dtype) is in the streaming Pallas selector's measured
+    win regime on the given backend (default: the ambient one). The single
+    definition of the dispatch rule — used by :func:`select_k` and by the
+    in-jit routed selects inside ivf_pq's scan (the CAGRA build chunk's
+    k=gpu_top_k+1 select reaches the kernel through this same predicate)."""
+    if backend is None:
+        backend = jax.default_backend()
+    return (backend == "tpu" and n >= 65536 and 0 < k <= _dispatch_cap()
+            and dtype in (jnp.float32, jnp.bfloat16, jnp.float16))
+
+
+def select_k_impl(values, in_idx, k: int, select_min: bool,
+                  impl: str = "auto"):
+    """In-jit routed top-k: the trace-time dispatch between lax.top_k and the
+    streaming Pallas selector, callable from inside jitted pipelines (no
+    nested-jit re-dispatch; shapes are static at trace time).
+
+    ``impl``: "auto" applies :func:`wide_dispatch_ok`; "xla" forces
+    lax.top_k; "pallas" forces the Pallas kernel (float inputs only — the
+    kernel ranks after an f32 cast) and is the A/B lever
+    ``bench/cagra_build_select_ab.py`` uses at the CAGRA build-chunk shapes.
+    """
+    expects(impl in ("auto", "xla", "pallas"),
+            "select impl must be 'auto', 'xla' or 'pallas', got %r", impl)
+    n = values.shape[1]
+    use_pallas = (impl == "pallas" or
+                  (impl == "auto" and wide_dispatch_ok(n, k, values.dtype)))
+    if use_pallas:
+        expects(values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16),
+                "the Pallas selector ranks after an f32 cast; integer or "
+                "f64 values (%s) need the exact lax.top_k path "
+                "(same restriction as the public select_k dispatch)",
+                values.dtype)
+        from ..ops.topk import topk_pallas
+
+        out_v, pos = topk_pallas(values, int(k), select_min=bool(select_min))
+        out_i = (pos if in_idx is None
+                 else jnp.take_along_axis(in_idx, pos, axis=1))
+        return out_v, out_i.astype(jnp.int32)
+    return _select_k(values, in_idx, int(k), bool(select_min))
 
 
 @functools.partial(jax.jit, static_argnames=("k", "select_min"))
@@ -80,19 +151,19 @@ def select_k(values, k: int, select_min: bool = True, indices=None):
     # floats: the kernel ranks after an f32 cast, so under jax_enable_x64 a
     # float64 row whose entries differ only beyond f32 precision would be
     # silently misranked vs the exact lax.top_k path.
-    # k <= 128 includes the r05 bitonic-merge wide path (ops/topk.py),
-    # measured 3.06x lax.top_k at (10k, 65k) k=128 in-process
-    # (BASELINE.md "Round-5 wide-k selector study"). 128 < k <= 256 also
-    # measured ahead (1.5-1.7x) but is NOT dispatched: two kh=256 kernel
-    # instances inside one XLA program hit a TPU-internal error (standalone
-    # calls are fine — callers can invoke ops.topk_pallas directly), and
-    # this dispatch can be embedded anywhere.
+    # k <= 64 is the r05-measured narrow path; 64 < k <= 256 is the
+    # bitonic-merge wide path (ops/topk.py) — 3.06x lax.top_k at (10k, 65k)
+    # k=128, 1.5-1.7x at k=193/256 in-process (BASELINE.md "Round-5 wide-k
+    # selector study"). r05 capped dispatch at 128 (two kh=256 instances per
+    # program hit a Mosaic error); r06's half-width merge lifts the cap to
+    # the kernel's full 256 (SELECT_K_DISPATCH_MAX_K above has the history
+    # and the RAFT_TPU_WIDE_SELECT_CAP escape hatch).
     # Integer values (exact int32 scores from the s8 search paths, uint8
-    # payload matrices, ...) also stay on the lax.top_k path: the Pallas
+    # payload matrices, ...) stay on the lax.top_k path: the Pallas
     # selector ranks after an f32 cast, which would misrank int32 values
     # differing only beyond 2^24; _select_k handles them exactly.
-    if (jax.default_backend() == "tpu" and n >= 65536 and 0 < k <= 128
-            and values.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
+    if (not jnp.issubdtype(values.dtype, jnp.integer)
+            and wide_dispatch_ok(n, int(k), values.dtype)):
         from ..ops.topk import topk_pallas
 
         out_v, pos = topk_pallas(values, int(k), select_min=bool(select_min))

@@ -92,6 +92,21 @@ class IndexParams:
     # Honored down to 1 (lower = more, smaller dispatches; useful when VMEM
     # limits bite at high d); values below ~1024 cost dispatch overhead
     build_chunk: int = 16384
+    # top-k implementation for the build self-search's candidate selects
+    # (k = gpu_top_k + 1, 193 at defaults — the call site the wide-k Pallas
+    # selector was commissioned for, VERDICT r4 #5 / r5 #3). Threads into
+    # ivf_pq.SearchParams.select_impl inside _build_chunk_step:
+    #   "auto"   — the measured select_k dispatch rule (k <= 256 reachable
+    #              since r06's half-width merge lifted the chaining cap;
+    #              build-chunk per-chunk widths of ~10-40k cols sit below
+    #              the 65536-col wide-k threshold, so auto stays on
+    #              lax.top_k until the driver A/B justifies lowering it).
+    #   "pallas" — force the streaming selector (the A/B arm
+    #              bench/cagra_build_select_ab.py measures; two wide
+    #              instances per program — per-chunk + final merge — is
+    #              exactly the composition the r06 workaround unlocked).
+    #   "xla"    — force lax.top_k.
+    build_select_impl: str = "auto"
     seed: int = 0
 
 
@@ -126,14 +141,23 @@ class SearchParams:
     #   >0 → explicit pool size, honored as-is.
     seed_pool: int = -1
     # hop-loop implementation (r05, VERDICT r4 #1; full study in
-    # BASELINE.md "Round-5 fused hop study"):
+    # BASELINE.md "Round-5 fused hop study"; r06 arena iteration in
+    # "Round-6 arena residual attack"):
     #   "auto" → "fused_arena" on TPU when eligible (itopk +
     #     search_width*degree <= 128), else the XLA loop.
     #   "fused_arena" — ONE Pallas launch per hop (scoring + dedup + merge +
     #     pick, beam state VMEM-resident; gathers stay in XLA per the r04
     #     head-to-head) with a threshold-gated arena merge: candidates
     #     insert over the arena's worst only while they beat it, so late
-    #     hops pay ~0 merge passes. Measured 1.27x the XLA loop in-process
+    #     hops pay ~0 merge passes. Since r06 the insertion loop carries
+    #     its gate in a register and its candidate scores as loop values —
+    #     the r05 profile named the per-candidate SMEM handshake + pool
+    #     scratch round-trips as the ~5 us/query residual between the
+    #     shipped 1.27x and the profiled 1.6x merge-free ceiling, and this
+    #     form removes exactly those terms.
+    #   "fused_arena_smem" — the r05 arena loop kept verbatim (SMEM gate,
+    #     scratch-stashed pool): the control arm for the r06 A/B
+    #     (bench/cagra_hop_ab.py). Measured 1.27x the XLA loop in-process
     #     at 1M itopk=32, identical recall.
     #   "fused" — same kernel with the sorted extraction merge (itopk
     #     unconditional passes); measured NEUTRAL vs XLA — kept as the
@@ -246,7 +270,8 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
         xb = x[s:s + chunk]
         rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
         return _build_chunk_step(x, pq, xb, rows, probes, int(gpu_top_k),
-                                 int(k), mt, int(res.workspace_bytes))
+                                 int(k), mt, int(res.workspace_bytes),
+                                 params.build_select_impl)
 
     probes = int(params.build_n_probes)
     parts = []
@@ -279,11 +304,11 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
             xt = x[rt]
             wide_h = np.asarray(_build_chunk_step(
                 x, pq, xt, rt, 32, int(gpu_top_k), int(k), mt,
-                int(res.workspace_bytes)))
+                int(res.workspace_bytes), params.build_select_impl))
             for p_try in (8, 16):
                 trial = np.asarray(_build_chunk_step(
                     x, pq, xt, rt, p_try, int(gpu_top_k), int(k), mt,
-                    int(res.workspace_bytes)))
+                    int(res.workspace_bytes), params.build_select_impl))
                 overlap = np.mean([
                     len(set(a) & set(b)) / len(a)
                     for a, b in zip(trial.tolist(), wide_h.tolist())])
@@ -304,9 +329,10 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_probes", "gpu_top_k", "k", "metric", "workspace_bytes"))
+    static_argnames=("n_probes", "gpu_top_k", "k", "metric", "workspace_bytes",
+                     "select_impl"))
 def _build_chunk_step(x, pq, xb, rows, n_probes: int, gpu_top_k: int, k: int,
-                      metric, workspace_bytes: int):
+                      metric, workspace_bytes: int, select_impl: str = "auto"):
     """One knn-graph build chunk — PQ search + exact refine + self-edge drop —
     as a single program: on a slow tunnel the per-dispatch RPC dominates the
     build (identical code measured 228 s to 20+ min), so N chunks must cost N
@@ -320,7 +346,10 @@ def _build_chunk_step(x, pq, xb, rows, n_probes: int, gpu_top_k: int, k: int,
     from ..core.resources import Resources
 
     chunk_res = Resources(workspace_bytes=workspace_bytes)
-    sp = ivf_pq_mod.SearchParams(n_probes=n_probes)
+    # select_impl threads the wide-k selector into the k = gpu_top_k + 1
+    # candidate selects below (the r05-commissioned call site; see
+    # IndexParams.build_select_impl)
+    sp = ivf_pq_mod.SearchParams(n_probes=n_probes, select_impl=select_impl)
     _, cand = ivf_pq_mod.search(sp, pq, xb, gpu_top_k + 1, res=chunk_res)
     _, refined = refine(x, xb, cand, k + 1, metric=metric, res=chunk_res)
     # drop self-edges (ref: build_knn_graph removes the query itself)
@@ -613,7 +642,7 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
 
     beam_ids, beam_d, beam_visited = dedup_sort(beam_ids, beam_d, beam_visited)
 
-    if hop_impl in ("fused", "fused_arena"):
+    if hop_impl in ("fused", "fused_arena", "fused_arena_smem"):
         # one Pallas launch per hop: scoring+dedup+merge+pick with beam state
         # VMEM-resident (VERDICT r4 #1; ops/cagra_hop.py docstring has the
         # profile-driven rationale). Beam distances carry the FULL ||v-q||^2
@@ -621,7 +650,8 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         from ..ops.cagra_hop import cagra_hop, hop_backend_ok
 
         _, interpret = hop_backend_ok()
-        merge = "arena" if hop_impl == "fused_arena" else "extract"
+        merge = {"fused": "extract", "fused_arena": "arena",
+                 "fused_arena_smem": "arena_smem"}[hop_impl]
         qn = jnp.sum(qf * qf, axis=1, keepdims=True)
         P = 128
         bd = jnp.full((m, P), jnp.inf, jnp.float32
@@ -664,7 +694,7 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
 
         bd, bi, bv, _, _, _ = lax.while_loop(
             fcond, fbody, (bd, bi, bv, pick, nocand, 0))
-        if merge == "arena":
+        if merge in ("arena", "arena_smem"):
             # arena beam is unsorted — one final sort (the XLA path pays a
             # sort per hop; arena pays it once here)
             from ..matrix.select_k import _select_k
@@ -741,9 +771,10 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int,
     of the candidate-block VMEM, widening fused eligibility at high d."""
     from ..ops.cagra_hop import hop_backend_ok, hop_shapes_eligible
 
-    expects(params.hop_impl in ("auto", "xla", "fused", "fused_arena"),
-            "hop_impl must be 'auto', 'xla', 'fused' or 'fused_arena', "
-            "got %r", params.hop_impl)
+    expects(params.hop_impl in ("auto", "xla", "fused", "fused_arena",
+                                "fused_arena_smem"),
+            "hop_impl must be 'auto', 'xla', 'fused', 'fused_arena' or "
+            "'fused_arena_smem', got %r", params.hop_impl)
     eligible = (hop_backend_ok()[0] and hop_shapes_eligible(
         params.itopk_size, graph_degree, params.search_width, dim,
         itemsize=itemsize))
@@ -753,7 +784,7 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int,
         # (1.27x in-process); plain "fused" (sorted extraction merge)
         # measured NEUTRAL and stays as the study's control
         return "fused_arena" if eligible else "xla"
-    if params.hop_impl in ("fused", "fused_arena"):
+    if params.hop_impl in ("fused", "fused_arena", "fused_arena_smem"):
         expects(eligible, "hop_impl='fused' needs itopk + "
                 "search_width*graph_degree <= 128, the staged candidate "
                 "block (128*search_width*graph_degree*d_pad*itemsize bytes, "

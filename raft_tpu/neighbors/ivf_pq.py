@@ -45,7 +45,7 @@ from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scal
                               serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
-from ..matrix.select_k import _select_k
+from ..matrix.select_k import _select_k, select_k_impl
 from ..random.rng import as_key
 from ._list_utils import (assign_to_lists, bound_capacity, list_positions,
                           plan_search_tiles, pq_scan_bytes_per_probe_row,
@@ -96,6 +96,21 @@ class IndexParams:
     # IP ranking far more — measured recall@5 0.375 joint vs 0.075 split on
     # tight clusters at 4x compression).
     pq8_split: bool | None = None
+    # Per-list residual scale normalization (VERDICT r5 #2, the heavytail
+    # remedy; reference counterpart: PER_CLUSTER codebook_gen is the
+    # reference's only scale-adaptation lever, ivf_pq_types.hpp:43 — this is
+    # the cheaper half of it). Population-skewed data (the repo's heavytail
+    # family: lognormal per-cluster residual scales) makes ONE codebook span
+    # orders of magnitude of residual norm, so the codewords concentrate on
+    # the large-scale clusters and small-scale lists quantize to mush
+    # (measured collapse: 0.28 recall @ 1M, BASELINE.md "Round-5 heavytail
+    # family"). True: store one f32 scale per list (RMS residual norm of the
+    # training members; global RMS for lists the trainset missed), train the
+    # codebooks on UNIT-scale residuals, encode r/s_list, and fold s back in
+    # at search inside the LUT (s^2 for L2, s for IP) — exact scoring of
+    # ||r - s*decode||^2, ~zero scan-time cost (the fold is one multiply on
+    # the per-probe LUT). Composes with either codebook_kind.
+    residual_scale_norm: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +159,22 @@ class SearchParams:
     # pairs per group for the grouped order (padding waste rises, and
     # amortization improves, with larger G)
     group_size: int = 16
+    # candidate top-k implementation for the scan's per-chunk + final-merge
+    # selects (matrix/select_k.py select_k_impl):
+    #   "auto"   — the measured dispatch rule (streaming Pallas selector on
+    #              TPU for f32 rows >= 65536 cols, k <= 256 since r06;
+    #              lax.top_k otherwise). The k <= 256 reach is what routes
+    #              CAGRA's build-chunk k=gpu_top_k+1 select through the
+    #              wide selector when its shapes qualify.
+    #   "xla"    — force lax.top_k (the r01-r05 behavior).
+    #   "pallas" — force the Pallas selector (f32 scores only); the A/B
+    #              lever bench/cagra_build_select_ab.py sweeps at the CAGRA
+    #              build-chunk shapes, whose per-chunk widths sit BELOW the
+    #              65536-column auto threshold — the driver measurement
+    #              decides whether auto's wide-k threshold should drop.
+    # The coarse cluster select (k = n_probes, n_lists cols) always stays
+    # on lax.top_k — never in the wide regime.
+    select_impl: str = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -161,6 +192,10 @@ class IvfPqIndex:
     # (n_lists, capacity) f32 per-vector scan constant for pq_split L2
     # (sum_s 2*cb1[s,hi_s]·cb2[s,lo_s]); (n_lists, 0) otherwise
     list_consts: jax.Array = None
+    # (n_lists,) f32 per-list residual scales (IndexParams.residual_scale
+    # _norm); (0,) = normalization disabled. Codes encode r/s_list; search
+    # folds s_list back into the LUT, so scores stay exact ||r - s*decode||^2
+    list_scales: jax.Array = None
     metric: DistanceType = DistanceType.L2Expanded
     codebook_kind: str = "per_subspace"
     pq_bits: int = 8
@@ -216,10 +251,19 @@ class IvfPqIndex:
     def __post_init__(self):
         if self.list_consts is None:
             self.list_consts = jnp.zeros((self.list_codes.shape[0], 0), jnp.float32)
+        if self.list_scales is None:
+            self.list_scales = jnp.zeros((0,), jnp.float32)
+
+    @property
+    def scale_normed(self) -> bool:
+        """True when codes encode per-list-normalized residuals (shape-level
+        flag, so it stays concrete inside jit traces)."""
+        return self.list_scales.shape[0] > 0
 
     def tree_flatten(self):
         children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
-                    self.list_codes, self.list_ids, self.list_sizes, self.list_consts)
+                    self.list_codes, self.list_ids, self.list_sizes,
+                    self.list_consts, self.list_scales)
         return children, (self.metric, self.codebook_kind, self.pq_bits,
                           self.split_factor, self.pq_split, self.data_kind)
 
@@ -418,6 +462,37 @@ def _per_cluster_gain(resid, labels, codebooks, split: bool, key, n_iters: int,
     return float(err_pc) / max(float(err_ps), 1e-30)
 
 
+@functools.partial(jax.jit, static_argnames=("n_lists",))
+def _per_list_residual_scales(resid, labels, n_lists: int):
+    """(n_lists,) RMS residual scale per list from the training residuals:
+    s_l = sqrt(mean ||r||^2 / d_rot) over l's members; lists the trainset
+    missed fall back to the global RMS (a fresh list has no scale evidence,
+    and 1.0 would be arbitrary on data whose scales are nowhere near 1).
+    Accumulation is a chunked one-hot matmul, not a scatter-add — XLA
+    serializes scatters on TPU (the _reverse_merge lesson)."""
+    n, pq_dim, pq_len = resid.shape
+    rn2 = jnp.sum(resid.reshape(n, -1) ** 2, axis=1)
+    blk = min(16384, max(round_up(n, 8), 8))
+    num = -(-n // blk)
+    rp = jnp.pad(rn2, (0, num * blk - n))
+    # padding rows carry label n_lists — summed into a discard bucket
+    lp = jnp.pad(labels.astype(jnp.int32), (0, num * blk - n),
+                 constant_values=n_lists)
+
+    def body(args):
+        r, l = args
+        oh = jax.nn.one_hot(l, n_lists + 1, dtype=jnp.float32, axis=0)
+        return oh @ r, jnp.sum(oh, axis=1)
+
+    sums, counts = lax.map(body, (rp.reshape(num, blk), lp.reshape(num, blk)))
+    s = jnp.sum(sums, axis=0)[:n_lists]
+    c = jnp.sum(counts, axis=0)[:n_lists]
+    gmean = jnp.sum(rn2) / jnp.maximum(n, 1)
+    msq = jnp.where(c > 0, s / jnp.maximum(c, 1.0), gmean)
+    d_rot = pq_dim * pq_len
+    return jnp.sqrt(jnp.maximum(msq / d_rot, 1e-24))
+
+
 def _pq_cross_consts(codes, codebooks, labels, per_cluster: bool):
     """Per-vector scan constant for split L2 scoring: sum_s 2*cb1[s,hi_s]·
     cb2[s,lo_s] — the cross term of ||cb1+cb2||^2 that the separated hi/lo
@@ -591,6 +666,15 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         labels = assign_to_lists(xt, centers, mt, tile)
         resid = (xt.astype(jnp.float32) - jnp.take(centers, labels, axis=0)) @ rotation.T
         resid = resid.reshape(n_train, pq_dim, pq_len)
+    list_scales = jnp.zeros((0,), jnp.float32)
+    if params.residual_scale_norm:
+        # per-list scale normalization (see IndexParams docstring): train
+        # the codebooks — and the auto per-cluster trial below — on
+        # unit-scale residuals; encode/search re-apply s_list exactly
+        with tracing.range("ivf_pq.build.residual_scales"):
+            list_scales = _per_list_residual_scales(resid, labels,
+                                                    params.n_lists)
+        resid = resid / jnp.take(list_scales, labels)[:, None, None]
 
     # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
     key, kc = jax.random.split(key)
@@ -657,6 +741,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         list_codes=jnp.zeros((params.n_lists, 0, pq_dim), jnp.uint8),
         list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+        list_scales=list_scales,
         metric=mt,
         codebook_kind=kind,
         pq_bits=params.pq_bits,
@@ -755,6 +840,10 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
         labels = assign_to_lists(x, index.centers, index.metric, tile)
     resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
     resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
+    if index.scale_normed:
+        # codes encode UNIT-scale residuals; search re-applies s_list in the
+        # LUT (IndexParams.residual_scale_norm)
+        resid = resid / jnp.take(index.list_scales, labels)[:, None, None]
     per_cluster = index.codebook_kind == "per_cluster"
     # split indexes encode against the effective composed 256-entry codebook
     # (joint argmin over the Minkowski sum — optimal for this codebook, and
@@ -774,6 +863,10 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
         # separable, so split IP indexes keep the empty (n_lists, 0) buffer
         # (no dead capacity-sized zeros stored/serialized/sharded)
         consts = _pq_cross_consts(codes, index.codebooks, labels, per_cluster)
+        if index.scale_normed:
+            # the stored cross term enters scoring raw, so the s^2 of
+            # ||r - s*(cb1+cb2)||^2 folds in HERE, at encode time
+            consts = consts * jnp.take(index.list_scales, labels) ** 2
 
     if index.capacity > 0 and index.size > 0:
         old_mask = index.list_ids.reshape(-1) >= 0
@@ -796,29 +889,36 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     sf = index.split_factor if split_factor is None else split_factor
     labels, rep, n_lists, capacity, _ = bound_capacity(labels, index.n_lists, sf)
     centers, centers_rot, codebooks = index.centers, index.centers_rot, index.codebooks
+    list_scales = index.list_scales
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
         centers_rot = jnp.asarray(np.repeat(np.asarray(centers_rot), rep, axis=0))
         if index.codebook_kind == "per_cluster":
             codebooks = jnp.asarray(np.repeat(np.asarray(codebooks), rep, axis=0))
+        if index.scale_normed:
+            # sub-lists share their parent's center AND its residual scale
+            # (codes were encoded against both)
+            list_scales = jnp.asarray(
+                np.repeat(np.asarray(list_scales), rep, axis=0))
     with tracing.range("ivf_pq.extend.fill_lists"):
         buf, idbuf, sizes, cbuf = _fill_code_lists(
             codes, new_ids, labels, n_lists, capacity, consts)
     return dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
         list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
-        split_factor=sf,
+        list_scales=list_scales, split_factor=sf,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("n_probes", "k", "query_tile", "probe_chunk", "metric",
-                     "codebook_kind", "lut_dtype", "scan_impl"),
+                     "codebook_kind", "lut_dtype", "scan_impl", "select_impl"),
 )
 def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: int,
                probe_chunk: int, metric: DistanceType, codebook_kind: str, lut_dtype: str,
-               keep_mask=None, scan_impl: str = "onehot"):
+               keep_mask=None, scan_impl: str = "onehot",
+               select_impl: str = "auto"):
     m, d = queries.shape
     qf = queries.astype(jnp.float32)
     inner = metric == DistanceType.InnerProduct
@@ -858,6 +958,14 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
             crot = index.centers_rot[pc]  # (T, pc, d_rot)
 
             # ---- LUT (ref ivfpq_search_worker :419 lut computation) ----
+            # per-list residual scales (IndexParams.residual_scale_norm):
+            # codes decode to s_list * codeword, so the fold is one multiply
+            # on the per-probe LUT — s^2 for L2 (with the residual
+            # pre-divided so dots see the unit-scale domain the codebooks
+            # were trained in), s for IP. Bias terms stay in the RAW
+            # residual domain (they carry ||r||^2 / q·c exactly).
+            sc = (jnp.take(index.list_scales, pc, axis=0)
+                  if index.scale_normed else None)       # (T, pc) | None
             if inner:
                 # IP(q, v) = q·c + q_rot·decoded_residual: LUT over the rotated
                 # query's subvectors; the q·c bias is added to scores below.
@@ -868,10 +976,17 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
                     lut = jnp.einsum("tpsl,skl->tpsk", qs, cb, precision=lax.Precision.HIGHEST)
                 else:
                     lut = jnp.einsum("tpsl,tpkl->tpsk", qs, cb[pc], precision=lax.Precision.HIGHEST)
+                if sc is not None:
+                    lut = lut * sc[:, :, None, None]
                 bias = jnp.einsum("td,tpd->tp", q, crot, precision=lax.Precision.HIGHEST)
             else:
                 # L2: ‖q - c - decoded‖² = Σ_s ‖r_s - codeword_s‖², r = q_rot - c_rot
                 r = (q[:, None, :] - crot).reshape(query_tile, probe_chunk, pq_dim, pq_len)
+                # Σ_s ‖r_s‖² per probe: constant within a list, needed so
+                # scores are comparable across probed lists
+                bias = jnp.sum(r * r, axis=(2, 3))  # (T, pc)
+                if sc is not None:
+                    r = r / sc[:, :, None, None]
                 if codebook_kind == "per_subspace":
                     # cb: (pq_dim, n_codes, pq_len)
                     dots = jnp.einsum("tpsl,skl->tpsk", r, cb, precision=lax.Precision.HIGHEST)
@@ -880,9 +995,8 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
                     cbl = cb[pc]  # (T, pc, n_codes, pq_len)
                     dots = jnp.einsum("tpsl,tpkl->tpsk", r, cbl, precision=lax.Precision.HIGHEST)
                     lut = cb_n2[pc][:, :, None, :] - 2.0 * dots
-                # Σ_s ‖r_s‖² per probe: constant within a list, needed so
-                # scores are comparable across probed lists
-                bias = jnp.sum(r * r, axis=(2, 3))  # (T, pc)
+                if sc is not None:
+                    lut = lut * (sc * sc)[:, :, None, None]
 
             # ---- scan: score = Σ_s LUT[s, code_s] (ref compute_similarity) ----
             # One-hot MXU formulation: Σ_s LUT[s, c_s] = onehot(codes)·LUTflat.
@@ -973,12 +1087,17 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
                 scores = apply_id_filter(scores, ids, keep_mask, not inner)
             flat_s = scores.reshape(query_tile, probe_chunk * cap)
             flat_i = ids.reshape(query_tile, probe_chunk * cap)
-            return c + 1, _select_k(flat_s, flat_i, k, not inner)
+            # candidate selects route through the dispatching selector
+            # (r06): at wide k this is the call site the Pallas wide-k
+            # kernel was commissioned for — CAGRA's build chunk reaches
+            # here with k = gpu_top_k + 1 (193 at defaults)
+            return c + 1, select_k_impl(flat_s, flat_i, k, not inner,
+                                        impl=select_impl)
 
         _, (cv, ci) = lax.scan(per_chunk, 0, None, length=n_chunks)
         cv = jnp.moveaxis(cv, 0, 1).reshape(query_tile, n_chunks * k)
         ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
-        return _select_k(cv, ci, k, not inner)
+        return select_k_impl(cv, ci, k, not inner, impl=select_impl)
 
     with tracing.range("ivf_pq.search.scan"):
         dists, idx = lax.map(per_tile, (qt, pt))
@@ -995,12 +1114,12 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
 @functools.partial(
     jax.jit,
     static_argnames=("n_probes", "k", "metric", "codebook_kind", "lut_dtype",
-                     "group_size", "group_chunk"),
+                     "group_size", "group_chunk", "select_impl"),
 )
 def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
                        metric: DistanceType, codebook_kind: str,
                        lut_dtype: str, keep_mask=None, group_size: int = 16,
-                       group_chunk: int = 32):
+                       group_chunk: int = 32, select_impl: str = "auto"):
     """Probe-major grouped scan (r04, BASELINE.md "Round-4 PQ scan study"):
     the per-(query, probe) one-hot contraction is an N=1 batched matvec that
     rebuilds a (cap, pq_dim*K) one-hot operand per pair. Here the (query,
@@ -1079,6 +1198,10 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
         # ---- LUTs for this chunk's slots ----
         qr = jnp.take(qrot, qs.reshape(-1), axis=0)        # (Gc*G, d_rot)
         crot = jnp.take(index.centers_rot, ls.reshape(-1), axis=0)
+        # per-list residual scales: same LUT fold as the tiled path (s for
+        # IP, s^2 for L2 with the residual pre-divided); bias stays raw
+        sc = (jnp.take(index.list_scales, ls.reshape(-1), axis=0)
+              if index.scale_normed else None)             # (Gc*G,) | None
         if inner:
             rs = qr.reshape(-1, pq_dim, pq_len)
             if codebook_kind == "per_subspace":
@@ -1088,10 +1211,15 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
                 cbl = jnp.take(cb, ls.reshape(-1), axis=0)
                 lut = jnp.einsum("nsl,nkl->nsk", rs, cbl,
                                  precision=lax.Precision.HIGHEST)
+            if sc is not None:
+                lut = lut * sc[:, None, None]
             bias = jnp.einsum("nd,nd->n", qr, crot,
                               precision=lax.Precision.HIGHEST)
         else:
             r = (qr - crot).reshape(-1, pq_dim, pq_len)
+            bias = jnp.sum(r * r, axis=(1, 2))
+            if sc is not None:
+                r = r / sc[:, None, None]
             if codebook_kind == "per_subspace":
                 dots = jnp.einsum("nsl,skl->nsk", r, cb,
                                   precision=lax.Precision.HIGHEST)
@@ -1101,7 +1229,8 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
                 dots = jnp.einsum("nsl,nkl->nsk", r, cbl,
                                   precision=lax.Precision.HIGHEST)
                 lut = jnp.take(cb_n2, ls.reshape(-1), axis=0)[:, None] - 2.0 * dots
-            bias = jnp.sum(r * r, axis=(1, 2))
+            if sc is not None:
+                lut = lut * (sc * sc)[:, None, None]
         lutf = lut.reshape(Gc, G, pq_dim * n_codes)
 
         # ---- shared one-hot per group's list ----
@@ -1142,7 +1271,8 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
             from .sample_filter import apply_id_filter
 
             sc_t = apply_id_filter(sc_t, ids_t, keep_mask, not inner)
-        sv, si = _select_k(sc_t, ids_t, k, not inner)      # (Gc*G, k)
+        sv, si = select_k_impl(sc_t, ids_t, k, not inner,
+                               impl=select_impl)          # (Gc*G, k)
         sv = jnp.where(live.reshape(-1, 1), sv, bad)
         si = jnp.where(live.reshape(-1, 1), si, -1)
         return sv, si
@@ -1157,7 +1287,7 @@ def _pq_search_grouped(index: IvfPqIndex, queries, n_probes: int, k: int,
     inv = jnp.argsort(order)                               # orig-pair order
     pv = jnp.take(pv, inv, axis=0).reshape(m, n_probes * k)
     pi = jnp.take(pi, inv, axis=0).reshape(m, n_probes * k)
-    dists, idx = _select_k(pv, pi, k, not inner)
+    dists, idx = select_k_impl(pv, pi, k, not inner, impl=select_impl)
     if not inner and metric in (DistanceType.L2SqrtExpanded,
                                 DistanceType.L2SqrtUnexpanded):
         dists = jnp.where(jnp.isfinite(dists),
@@ -1207,6 +1337,15 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
             params.lut_dtype)
     n_codes = index.codebooks.shape[-2]
     scan_impl = resolve_scan_impl(params, index, n_codes)
+    expects(params.select_impl in ("auto", "xla", "pallas"),
+            "select_impl must be 'auto', 'xla' or 'pallas', got %r",
+            params.select_impl)
+    if params.select_impl == "pallas":
+        from ..ops.topk import TOPK_MAX_K
+
+        expects(k <= TOPK_MAX_K,
+                "select_impl='pallas' selects with the streaming kernel: "
+                "k=%d must be <= %d", k, TOPK_MAX_K)
     query_tile, probe_chunk = plan_search_tiles(
         m, n_probes, int(k), index.capacity,
         bytes_per_probe_row=pq_scan_bytes_per_probe_row(
@@ -1241,11 +1380,12 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
         return _pq_search_grouped(
             index, queries, n_probes, int(k), index.metric,
             index.codebook_kind, params.lut_dtype, keep_mask,
-            group_size=int(params.group_size))
+            group_size=int(params.group_size),
+            select_impl=params.select_impl)
     return _pq_search(
         index, queries, n_probes, int(k), query_tile, probe_chunk, index.metric,
         index.codebook_kind, params.lut_dtype,
-        keep_mask, scan_impl=scan_impl,
+        keep_mask, scan_impl=scan_impl, select_impl=params.select_impl,
     )
 
 
@@ -1261,7 +1401,7 @@ def save(index: IvfPqIndex, path: str) -> None:
         serialize_scalar(f, index.data_kind)
         for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
                     index.list_codes, index.list_ids, index.list_sizes,
-                    index.list_consts):
+                    index.list_consts, index.list_scales):
             serialize_mdspan(f, arr)
 
 
@@ -1280,6 +1420,13 @@ def load(path: str, res: Resources | None = None) -> IvfPqIndex:
                 if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5")
                 else "float32")
         arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(8)]
+        # raft_tpu/7 added list_scales (residual_scale_norm); older files
+        # never normalized, so the disabled (0,) sentinel is exact
+        if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
+                       "raft_tpu/6"):
+            arrs.append(jnp.asarray(deserialize_mdspan(f)))
+        else:
+            arrs.append(jnp.zeros((0,), jnp.float32))
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
                       split_factor=split_factor, pq_split=pq_split,
                       data_kind=kind)

@@ -90,10 +90,16 @@ def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
     """``profile`` carves phases out for the in-kernel profile
     (bench/cagra_hop_profile.py): "full", "noscore" (skip the distance
     computation), "nodedup" (skip the beam-membership masks), "nomerge"
-    (skip dedup+extraction — beam passes through, pick still computed).
+    (skip dedup+extraction — beam passes through, pick still computed),
+    "nogate" (arena merges only: run the insertion loop UNGATED — the
+    full-vs-nogate delta is the threshold gate's measured worth).
     ``merge``: "extract" (itopk ascending-extraction passes; beam stays
-    sorted) or "arena" (threshold-gated insertion into an unsorted arena —
-    the caller sorts once after the loop)."""
+    sorted), "arena" (threshold-gated insertion into an unsorted arena —
+    the caller sorts once after the loop; r06 form, gate carried in a
+    register and candidate scores carried as loop values), or "arena_smem"
+    (the r05 arena: gate handshake through SMEM, candidate pool stashed in
+    VMEM scratch and re-read per candidate — kept verbatim as the A/B
+    control for the r06 iteration)."""
     def kernel(q_ref, bd_ref, bi_ref, bv_ref, nbr_ref, vec_ref, valid_ref,
                nbd_ref, nbi_ref, nbv_ref, pick_ref, nocand_ref,
                pd_ref, pi_ref, pv_ref, go_ref):
@@ -132,12 +138,13 @@ def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
             _emit_pick(itopk, width, qt, lane, nbd_ref, nbi_ref, nbv_ref,
                        pick_ref, nocand_ref)
             return
-        if profile != "nodedup" and not (merge == "arena"
-                                         and profile == "full"):
+        arena = merge in ("arena", "arena_smem") and profile in ("full",
+                                                                 "nogate")
+        if profile != "nodedup" and not arena:
             for b in range(itopk):
                 nd = jnp.where(nbr == bi[:, b:b + 1], _INF, nd)
 
-        if merge == "arena" and profile == "full":
+        if arena:
             # ---- threshold-gated arena merge: the beam is an UNSORTED
             # arena of itopk slots (sorted once in XLA after the loop); a
             # candidate is inserted — replacing the arena's current worst —
@@ -149,46 +156,100 @@ def _make_hop_kernel(itopk: int, cw: int, width: int, qt: int, dp: int,
             nbd_ref[...] = bd_ref[...]
             nbi_ref[...] = bi
             nbv_ref[...] = bv_ref[...]
-            # stash candidate scores in the pool scratch (ids in pi)
-            pd_ref[:, :cw] = nd
-            pi_ref[:, :cw] = nbr
-            go_ref[0] = 1
-            for t in range(cw):
-                @pl.when(go_ref[0] == 1)
-                def _insert(t=t):
-                    ad = nbd_ref[...]
-                    admask = jnp.where(lane < itopk, ad, _NEG)
-                    worst = jnp.max(admask, axis=1, keepdims=True)
-                    cd = pd_ref[:, :cw]
-                    best = jnp.min(cd, axis=1, keepdims=True)
-                    improve = best < worst              # (qt, 1)
-                    go_ref[0] = jnp.any(improve).astype(jnp.int32)
+            if merge == "arena_smem":
+                # r05 form (the A/B control): gate handshake through an
+                # SMEM scalar, candidate pool stashed in scratch and
+                # re-read per candidate — the ~5 us/query residual the r05
+                # profile named ("gated-loop scalar checks and pool I/O")
+                pd_ref[:, :cw] = nd
+                pi_ref[:, :cw] = nbr
+                go_ref[0] = 1
+                for t in range(cw):
+                    def _insert(t=t):
+                        ad = nbd_ref[...]
+                        admask = jnp.where(lane < itopk, ad, _NEG)
+                        worst = jnp.max(admask, axis=1, keepdims=True)
+                        cd = pd_ref[:, :cw]
+                        best = jnp.min(cd, axis=1, keepdims=True)
+                        improve = best < worst              # (qt, 1)
+                        go_ref[0] = jnp.any(improve).astype(jnp.int32)
 
-                    @pl.when(jnp.any(improve))
-                    def _apply():
-                        cdv = pd_ref[:, :cw]
-                        civ = pi_ref[:, :cw]
-                        bid = jnp.min(jnp.where(cdv <= best, civ, _BIG),
+                        @pl.when(jnp.any(improve))
+                        def _apply():
+                            cdv = pd_ref[:, :cw]
+                            civ = pi_ref[:, :cw]
+                            bid = jnp.min(jnp.where(cdv <= best, civ, _BIG),
+                                          axis=1, keepdims=True)
+                            # dedup HERE instead of a 32-pass pre-mask: a
+                            # candidate already in the arena carries the
+                            # same exact score — consume it, don't insert
+                            ai = nbi_ref[...]
+                            dup = jnp.any((ai == bid) & (lane < itopk),
+                                          axis=1, keepdims=True)
+                            ins = improve & jnp.logical_not(dup)
+                            # arena slot to evict: the worst entry, highest
+                            # lane on ties (any one copy)
+                            wsel = (admask >= worst)
+                            wlane = jnp.max(jnp.where(wsel, lane, -1),
+                                            axis=1, keepdims=True)
+                            at = ins & (lane == wlane)
+                            nbd_ref[...] = jnp.where(at, best, ad)
+                            nbi_ref[...] = jnp.where(at, bid, ai)
+                            nbv_ref[...] = jnp.where(at, 0, nbv_ref[...])
+                            # consume the candidate (all copies of its id)
+                            pd_ref[:, :cw] = jnp.where(
+                                improve & (civ == bid), _INF, cdv)
+
+                    if profile == "nogate":
+                        _insert()
+                    else:
+                        pl.when(go_ref[0] == 1)(_insert)
+            else:
+                # r06 form: the gate lives in a REGISTER (lax.cond carries
+                # it across iterations as a loop value — no SMEM write+read
+                # handshake serializing the VPU per candidate), candidate
+                # scores ride the fori_loop carry (vregs, no pool-scratch
+                # round trips), candidate ids are the already-loaded nbr
+                # (never mutated), and the one any(improve) reduction both
+                # closes the gate and masks the writes — the r05 loop paid
+                # it twice plus two scratch re-reads per candidate. The
+                # insertion math (tie-breaks, dedup-on-insert, eviction
+                # lane) is unchanged from arena_smem.
+                itmask = lane < itopk
+
+                def _insert_step(_, carry):
+                    go, cd = carry
+
+                    def _live():
+                        ad = nbd_ref[...]
+                        admask = jnp.where(itmask, ad, _NEG)
+                        worst = jnp.max(admask, axis=1, keepdims=True)
+                        best = jnp.min(cd, axis=1, keepdims=True)
+                        improve = best < worst              # (qt, 1)
+                        bid = jnp.min(jnp.where(cd <= best, nbr, _BIG),
                                       axis=1, keepdims=True)
-                        # dedup HERE instead of a 32-pass pre-mask: a
-                        # candidate already in the arena carries the same
-                        # exact score there — consume it without inserting
                         ai = nbi_ref[...]
-                        dup = jnp.any((ai == bid) & (lane < itopk), axis=1,
+                        dup = jnp.any((ai == bid) & itmask, axis=1,
                                       keepdims=True)
                         ins = improve & jnp.logical_not(dup)
-                        # arena slot to evict: the worst entry, highest
-                        # lane on ties (any one copy)
                         wsel = (admask >= worst)
                         wlane = jnp.max(jnp.where(wsel, lane, -1), axis=1,
                                         keepdims=True)
                         at = ins & (lane == wlane)
+                        # masked writes: rows whose improve is false keep
+                        # their arena untouched, so no inner when-branch
                         nbd_ref[...] = jnp.where(at, best, ad)
                         nbi_ref[...] = jnp.where(at, bid, ai)
                         nbv_ref[...] = jnp.where(at, 0, nbv_ref[...])
-                        # consume the candidate (all copies of its id)
-                        pd_ref[:, :cw] = jnp.where(
-                            improve & (civ == bid), _INF, cdv)
+                        cd2 = jnp.where(improve & (nbr == bid), _INF, cd)
+                        return jnp.any(improve).astype(jnp.int32), cd2
+
+                    if profile == "nogate":
+                        return _live()
+                    return jax.lax.cond(go == 1, _live,
+                                        lambda: (jnp.int32(0), cd))
+
+                jax.lax.fori_loop(0, cw, _insert_step, (jnp.int32(1), nd))
         else:
             # ---- merge pool: [beam | candidates | +inf pad], one row ----
             pd_ref[...] = bd_ref[...]
@@ -269,6 +330,11 @@ def cagra_hop(queries, beam_d, beam_i, beam_v, nbrs, vecs, valid,
     Returns (beam_d, beam_i, beam_v, pick (m, width) i32 clipped >= 0,
     no_cand (m, width) i32). Beam distances are full ||v - q||^2.
     """
+    if merge not in ("extract", "arena", "arena_smem"):
+        raise ValueError(f"merge must be 'extract', 'arena' or 'arena_smem', "
+                         f"got {merge!r}")
+    if profile not in ("full", "noscore", "nodedup", "nomerge", "nogate"):
+        raise ValueError(f"unknown profile {profile!r}")
     m, d = queries.shape
     cw = nbrs.shape[1]
     dp = -(-d // 128) * 128

@@ -40,8 +40,11 @@ __all__ = ["topk_pallas", "TOPK_MAX_K"]
 # k <= 64: merge buffer is one 128-lane register (measured path).
 # 64 < k <= 256: the running buffer is kept SORTED and merged with the
 # sorted block candidates by a bitonic merge network (VERDICT r4 #5) —
-# log2(2k)+1 full-lane compare-exchange stages instead of k extraction
-# iterations (9 stages vs 256 at k=256).
+# since r06 at HALF the lane width: the first stage of the 2k-wide network
+# is an elementwise compare of the two k-wide halves (the discarded loser
+# half is never formed), so every merge intermediate is <= kh lanes wide.
+# log2(k) kh-lane compare-exchange stages instead of k extraction
+# iterations (8 stages at kh lanes vs 256 iterations at k=256).
 TOPK_MAX_K = 256
 _NEG = -3.0e38
 _BIG = 2**30
@@ -59,14 +62,15 @@ def _extract_topk_ids(v, ids, k):
     return jnp.concatenate(vals, axis=1), jnp.concatenate(idxs, axis=1)
 
 
-def _bitonic_merge_desc(v, ids, kh):
-    """Merge a (qt, 2*kh) bitonic sequence (descending run ++ reversed
-    descending candidates) into descending order, ids riding along; ties
-    resolve to the lower id, matching lax.top_k. All ops stay full
-    (qt, 2*kh)-lane-width — rolls instead of narrow reshapes (the r03
-    lesson: narrow-lane intermediates cost a vreg relayout each)."""
+def _bitonic_merge_desc(v, ids, s0):
+    """Sort a (qt, w) bitonic sequence into descending order with stages
+    s0, s0/2, ..., 1, ids riding along; ties resolve to the lower id,
+    matching lax.top_k. All ops stay full (qt, w)-lane-width — rolls
+    instead of narrow reshapes (the r03 lesson: narrow-lane intermediates
+    cost a vreg relayout each). With s0 == w/2 this is the full bitonic
+    merge network for a w-length bitonic sequence."""
     lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 1)
-    s = kh
+    s = s0
     while s >= 1:
         vf, idf = jnp.roll(v, -s, axis=1), jnp.roll(ids, -s, axis=1)
         vb, idb = jnp.roll(v, s, axis=1), jnp.roll(ids, s, axis=1)
@@ -85,7 +89,8 @@ def _bitonic_merge_desc(v, ids, kh):
 
 
 def _select_kernel(x_ref, out_i_ref, run_v, run_i, s_ref,
-                   cand_v, cand_i, go_ref, *, k, kh, blk, n, qt, select_min):
+                   cand_v, cand_i, go_ref, *, k, kh, blk, n, qt, select_min,
+                   wide_merge):
     j = pl.program_id(1)
     nb = pl.num_programs(1)
     wide = kh > 64
@@ -144,17 +149,40 @@ def _select_kernel(x_ref, out_i_ref, run_v, run_i, s_ref,
     else:
         # wide path: merge only when this block extracted anything (most
         # blocks beyond the first few are gated off entirely once tau
-        # tightens — an unconditional 2k-wide merge would dominate)
+        # tightens — an unconditional full-width merge would dominate)
         @pl.when(go_ref[1] == 1)
         def _merge():
             # run is sorted desc; candidates were written reversed (see
-            # tpos above) so cand is already ascending — the plain concat
-            # is bitonic with no flip
-            mv = jnp.concatenate([run_v[:, :kh], cand_v[:, :kh]], axis=1)
-            mi = jnp.concatenate([run_i[:, :kh], cand_i[:, :kh]], axis=1)
-            nv, ni = _bitonic_merge_desc(mv, mi, kh)
-            run_v[:, :kh] = nv[:, :kh]
-            run_i[:, :kh] = ni[:, :kh]
+            # tpos above) so cand is already ascending — run ++ cand is
+            # bitonic with no flip
+            rv, riv = run_v[:, :kh], run_i[:, :kh]
+            cv, civ = cand_v[:, :kh], cand_i[:, :kh]
+            if wide_merge == "half":
+                # half-width form (r06): the 2kh-wide network's first
+                # stage (stride kh) only ever routes the winner of
+                # (run[i], cand[i]) into the kept half — computed as one
+                # elementwise compare-exchange of the two kh-wide halves,
+                # whose output is itself bitonic. The remaining stages
+                # (kh/2 .. 1) never cross the half boundary, so NOTHING
+                # in the merge exceeds kh lanes: the kh=256 instance uses
+                # exactly the lane widths of the chaining-proven kh=128
+                # path (the workaround for the two-instance Mosaic
+                # failure, see topk_pallas docstring), and one full-width
+                # stage is saved outright.
+                win = (rv > cv) | ((rv == cv) & (riv < civ))
+                nv = jnp.where(win, rv, cv)
+                ni = jnp.where(win, riv, civ)
+                nv, ni = _bitonic_merge_desc(nv, ni, kh // 2)
+                run_v[:, :kh] = nv
+                run_i[:, :kh] = ni
+            else:  # "concat": the r05 formulation (2kh-lane concat +
+                # full network) — kept verbatim for the on-hardware
+                # chaining repro/bisect (bench/topk_chain_repro.py)
+                mv = jnp.concatenate([rv, cv], axis=1)
+                mi = jnp.concatenate([riv, civ], axis=1)
+                nv, ni = _bitonic_merge_desc(mv, mi, kh)
+                run_v[:, :kh] = nv[:, :kh]
+                run_i[:, :kh] = ni[:, :kh]
 
     @pl.when(j == nb - 1)
     def _emit():
@@ -162,9 +190,11 @@ def _select_kernel(x_ref, out_i_ref, run_v, run_i, s_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("k", "select_min", "blk", "qt", "interpret"))
+                   static_argnames=("k", "select_min", "blk", "qt", "interpret",
+                                    "wide_merge"))
 def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
-                qt: int = 256, interpret: bool | None = None):
+                qt: int = 256, interpret: bool | None = None,
+                wide_merge: str = "half"):
     """Top-k of each row of ``x`` (2-D) with source-column payloads.
 
     Returns (values (m, k), indices (m, k) int32), values sorted best-first.
@@ -181,16 +211,27 @@ def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
     inputs if distinctions above 2.9e38 matter; distance pipelines never get
     near this range.
 
-    ONE-INSTANCE-PER-PROGRAM LIMIT for k > 128: embedding two kh=256 kernel
-    instances (two k > 128 calls) inside one XLA program hits a TPU-internal
-    Mosaic error — standalone calls are fine, and the matrix/select_k.py
-    dispatch therefore never routes k > 128 here (it can be embedded
-    anywhere). If you call topk_pallas directly with k > 128, keep each call
-    in its own jit program, or use lax.top_k for the second selection.
+    kh=256 chaining history (r05 -> r06): embedding two kh=256 kernel
+    instances (two k > 128 calls) inside one XLA program used to hit a
+    TPU-internal Mosaic error, which capped the matrix/select_k.py dispatch
+    at k <= 128. The r05 merge built 2*kh-lane intermediates — 512 lanes at
+    kh=256, the ONLY lane width the chaining-proven kh=128 path never uses —
+    so ``wide_merge="half"`` (default) now computes the first network stage
+    as an elementwise compare of the two kh-wide halves (the discarded loser
+    half is never formed) and keeps every merge intermediate <= kh lanes;
+    the dispatch cap is lifted to k <= 256. ``wide_merge="concat"`` keeps
+    the r05 formulation verbatim so ``bench/topk_chain_repro.py`` can
+    reproduce and bisect the original failure on hardware; if a future
+    toolchain still rejects chained kh=256 "half" instances, re-cap the
+    dispatch with ``RAFT_TPU_WIDE_SELECT_CAP=128`` (see select_k) and run
+    the repro. The two-instance composition at the CAGRA build-chunk shapes
+    is pinned by ``tests/test_ops.py::test_topk_pallas_two_wide_instances``.
     """
     m, n = x.shape
     if k > min(TOPK_MAX_K, n):
         raise ValueError(f"k={k} must be <= min({TOPK_MAX_K}, n={n})")
+    if wide_merge not in ("half", "concat"):
+        raise ValueError(f"wide_merge must be 'half' or 'concat', got {wide_merge!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     blk = max(128, min(blk, -(-n // 128) * 128))
@@ -205,7 +246,8 @@ def topk_pallas(x, k: int, select_min: bool = True, blk: int = 4096,
     m_blocks = -(-m // qt)
     grid = (m_blocks, n_blocks)
     kern = functools.partial(_select_kernel, k=k, kh=kh, blk=blk, n=n, qt=qt,
-                             select_min=bool(select_min))
+                             select_min=bool(select_min),
+                             wide_merge=wide_merge)
     out_i = pl.pallas_call(
         kern,
         grid=grid,

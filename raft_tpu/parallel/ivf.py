@@ -260,6 +260,9 @@ def search_pq(comms: Comms, params, index, queries, k: int,
 
     _check_split_consts(index)
     scan_impl = resolve_scan_impl(params, index, n_codes)
+    expects(not index.scale_normed,
+            "distributed PQ search does not shard list_scales yet; a "
+            "residual_scale_norm index is single-chip only")
     expects(params.scan_order in ("auto", "tiled"),
             "the distributed search runs the tiled scan order; "
             "scan_order=%r is single-chip only", params.scan_order)
@@ -646,6 +649,10 @@ def build_pq(comms: Comms, params, dataset, res=None):
     expects(params.codebook_kind in ("auto", "per_subspace"),
             "the distributed build trains per-subspace codebooks "
             "(codebook_kind=%r is single-chip only)", params.codebook_kind)
+    expects(not getattr(params, "residual_scale_norm", False),
+            "residual_scale_norm is single-chip only (the distributed "
+            "build's pooled codebook training does not yet normalize "
+            "per-list scales)")
     pq_dim = params.pq_dim or pq_mod._default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
     d_rot = pq_dim * pq_len

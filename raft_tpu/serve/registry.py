@@ -116,7 +116,7 @@ class IndexRegistry:
     # -- publish / swap -----------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True) -> dict:
+                warm: bool = True, warm_data=None) -> dict:
         """Make ``(index, search_params)`` the active version of ``name``.
 
         Warms the searcher at every registry bucket shape for every ``k``
@@ -126,7 +126,12 @@ class IndexRegistry:
         publish against an already-warm program set reports
         ``compile_s == 0`` everywhere, which is the hiccup-free-swap proof
         (asserted by ``bench.py --serve``). ``warm=False`` skips warmup
-        (provisioning scripts that warmed out-of-band).
+        (provisioning scripts that warmed out-of-band). ``warm_data``
+        (optional (rows, dim) sample in the serving query dtype) draws the
+        warmup queries from real data instead of uniform noise — identical
+        program coverage (compilation is shape-keyed), representative
+        warm-time walls in the report (:func:`raft_tpu._warmup
+        .warm_buckets`).
         """
         from .._warmup import warm_buckets
 
@@ -173,7 +178,8 @@ class IndexRegistry:
                     report["warm"][int(kk)] = warm_buckets(
                         searcher, dim=searcher.dim,
                         dtype=searcher.query_dtype,
-                        buckets=self.buckets, k=int(kk))
+                        buckets=self.buckets, k=int(kk),
+                        sample=warm_data)
             to_retire: list[_Version] = []
             with self._lock:
                 old = self._active.get(name)

@@ -133,14 +133,17 @@ class SearchService:
     # -- publish ------------------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True) -> dict:
+                warm: bool = True, warm_data=None) -> dict:
         """Publish/hot-swap through the service's registry, warming against
         the SERVICE's bucket ladder (the shapes its streams actually flush).
-        Safe under load: in-flight requests finish on the old version."""
+        Safe under load: in-flight requests finish on the old version.
+        ``warm_data`` (optional (rows, dim) sample in the serving dtype)
+        draws the warmup queries from real data — see
+        :func:`raft_tpu._warmup.warm_buckets`."""
         with tracing.range("serve/publish/%s", name):
             return self.registry.publish(
                 name, index, search_params=search_params, k=k,
-                version=version, warm=warm)
+                version=version, warm=warm, warm_data=warm_data)
 
     # -- serving ------------------------------------------------------------
     def _stream(self, name: str, k: int) -> MicroBatcher:

@@ -205,3 +205,40 @@ def test_serve_flag_runs_only_the_serve_row(monkeypatch):
                    for r in bench._STATE["rows"])
     finally:
         bench._STATE["rows"].clear()
+
+
+def test_render_note_quotes_the_artifact():
+    """bench.py --note regenerates the BASELINE round-note table FROM the
+    committed artifact (VERDICT r5 #7: the r05 note described a different
+    session than BENCH_r05.json) — every number in the output must be a
+    number from the artifact, ratios included, with the driver wrapper
+    ({rc, tail, parsed}) unwrapped."""
+    import bench
+
+    artifact = {
+        "rc": 0, "tail": "...",
+        "parsed": {
+            "metric": "exact brute-force kNN QPS", "value": 192111.3,
+            "unit": "QPS", "vs_baseline": 1.734, "elapsed_s": 194.4,
+            "rows": [
+                {"name": "exact_fused_knn_100k", "qps": 192111.3,
+                 "recall": 1.0, "build_s": 0.0},
+                {"name": "exact_xla_control", "qps": 137586.3, "recall": 1.0,
+                 "build_s": 0.0, "fused_over_control": 1.396},
+                {"name": "cagra_1m_itopk32", "qps": 35879.4,
+                 "recall": 0.9714, "build_s": 135.6},
+                {"name": "ivf_pq_1m_i8", "qps": 30000.0, "recall": 0.97,
+                 "build_s": 5.0, "i8_over_f32": 0.87},
+                {"name": "broken_row", "error": "TPU fell over"},
+            ],
+        },
+    }
+    note = bench._render_note(artifact)
+    for needle in ("192,111.3", "137,586.3", "1.396", "35,879.4", "0.9714",
+                   "135.6", "i8/f32 **0.87**", "fused/control **1.396**",
+                   "vs_baseline 1.734", "broken_row | ERROR",
+                   "TPU fell over"):
+        assert needle in note, (needle, note)
+    # regression guard: the r05 drift was prose saying 162.8k/148.3k/1.098
+    for stale in ("162", "148,3", "1.098"):
+        assert stale not in note

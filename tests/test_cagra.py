@@ -147,7 +147,7 @@ class TestFusedHop:
     lowest-id ties, visited tracking), so same neighbor sets and distances
     up to summation order."""
 
-    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena", "fused_arena_smem"])
     def test_matches_xla_loop(self, index, data, monkeypatch, impl):
         monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
         x, q = data
@@ -165,7 +165,7 @@ class TestFusedHop:
                                    np.sort(np.asarray(d_x), 1),
                                    rtol=1e-4, atol=1e-4)
 
-    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena", "fused_arena_smem"])
     def test_recall_on_clustered(self, monkeypatch, impl):
         monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
         x, _ = make_blobs(3000, 24, n_clusters=30, cluster_std=0.5, seed=2)
@@ -197,7 +197,7 @@ class TestFusedHop:
         np.testing.assert_allclose(np.asarray(d_f), d_true, rtol=1e-4,
                                    atol=1e-4)
 
-    @pytest.mark.parametrize("impl", ["fused", "fused_arena"])
+    @pytest.mark.parametrize("impl", ["fused", "fused_arena", "fused_arena_smem"])
     def test_matches_xla_loop_width2(self, index, data, monkeypatch, impl):
         """search_width=2: two picks per hop, candidate block 2*deg — must
         still track the XLA loop."""
@@ -505,3 +505,24 @@ def test_shard_local_vs_global_graph_recall_64k():
     assert recall_sharded > 0.85, recall_sharded
     assert recall_sharded >= recall_global - 0.03, (
         recall_sharded, recall_global)
+
+
+@pytest.mark.slow
+def test_build_select_impl_pallas_matches_xla():
+    """IndexParams.build_select_impl routes the build self-search's
+    k = gpu_top_k + 1 candidate selects through the wide-k Pallas selector
+    (the r05-commissioned call site, VERDICT r5 #3). Both impls must produce
+    the IDENTICAL knn graph — the selector is exact with lax.top_k tie
+    semantics — and this exercises the two-wide-instances-per-program
+    composition (per-chunk + final merge) end to end through ivf_pq."""
+    rng = np.random.default_rng(5)
+    x = np.asarray(make_blobs(800, 16, n_clusters=10, cluster_std=0.6,
+                              seed=3)[0])
+    graphs = {}
+    for impl in ("xla", "pallas"):
+        params = cagra.IndexParams(
+            intermediate_graph_degree=48, graph_degree=16, refine_rate=2.0,
+            build_n_probes=8, build_chunk=800, build_select_impl=impl,
+            seed=0)
+        graphs[impl] = np.asarray(cagra.build_knn_graph(params, x))
+    np.testing.assert_array_equal(graphs["xla"], graphs["pallas"])

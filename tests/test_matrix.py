@@ -124,3 +124,58 @@ class TestOps:
         m = rng.random((4, 5)).astype(np.float32)
         np.testing.assert_array_equal(np.asarray(matrix.reverse(m)), m[:, ::-1])
         np.testing.assert_array_equal(np.asarray(matrix.reverse(m, along_rows=False)), m[::-1])
+
+
+class TestWideDispatch:
+    """The r06 dispatch lift (k <= 256) and its guard rails: the cap must
+    track the kernel's documented limit, and the predicate is the single
+    dispatch rule shared by select_k and the in-jit ivf_pq selects."""
+
+    def test_dispatch_cap_matches_kernel_limit(self):
+        from raft_tpu.matrix.select_k import SELECT_K_DISPATCH_MAX_K
+        from raft_tpu.ops.topk import TOPK_MAX_K
+
+        # a drift here means select_k promises a k the kernel rejects (or
+        # silently under-dispatches a lifted kernel limit)
+        assert SELECT_K_DISPATCH_MAX_K == TOPK_MAX_K == 256
+
+    def test_wide_dispatch_predicate(self):
+        from raft_tpu.matrix.select_k import wide_dispatch_ok
+
+        ok = lambda n, k, dt: wide_dispatch_ok(n, k, dt, backend="tpu")
+        assert ok(65536, 128, jnp.float32)
+        assert ok(65536, 193, jnp.float32)      # the CAGRA build-chunk k
+        assert ok(65536, 256, jnp.float32)      # r06 lift: full kernel range
+        assert not ok(65536, 257, jnp.float32)  # beyond the kernel
+        assert not ok(65535, 256, jnp.float32)  # below the measured regime
+        assert not ok(65536, 256, jnp.int32)    # integer ranking is exact-only
+        assert not wide_dispatch_ok(65536, 256, jnp.float32, backend="cpu")
+
+    def test_env_cap_escape_hatch(self, monkeypatch):
+        """RAFT_TPU_WIDE_SELECT_CAP re-imposes the r05 cap if a toolchain
+        regresses (documented in bench/topk_chain_repro.py)."""
+        from raft_tpu.matrix.select_k import wide_dispatch_ok
+
+        monkeypatch.setenv("RAFT_TPU_WIDE_SELECT_CAP", "128")
+        assert wide_dispatch_ok(65536, 128, jnp.float32, backend="tpu")
+        assert not wide_dispatch_ok(65536, 129, jnp.float32, backend="tpu")
+
+    def test_select_k_impl_forced_pallas_matches_xla(self, rng):
+        """The in-jit routed selector (ivf_pq's candidate selects): forced
+        'pallas' must agree with lax.top_k exactly, payload included."""
+        from raft_tpu.matrix.select_k import _select_k, select_k_impl
+
+        x = jnp.asarray(rng.random((6, 900)).astype(np.float32))
+        idx = jnp.asarray(rng.integers(0, 10_000, (6, 900)).astype(np.int32))
+        for select_min in (True, False):
+            v0, i0 = _select_k(x, idx, 70, select_min)
+            v1, i1 = select_k_impl(x, idx, 70, select_min, impl="pallas")
+            np.testing.assert_allclose(np.asarray(v1), np.asarray(v0), atol=0)
+            np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+
+    def test_select_k_impl_rejects_int_pallas(self, rng):
+        from raft_tpu.matrix.select_k import select_k_impl
+
+        x = jnp.asarray(rng.integers(0, 100, (4, 300)).astype(np.int32))
+        with pytest.raises(RaftError, match="integer"):
+            select_k_impl(x, None, 5, True, impl="pallas")
