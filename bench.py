@@ -39,6 +39,18 @@ archives per round:
                                  hot-swap proof (swap.failed == 0,
                                  swap.compile_s == 0). `--serve` runs ONLY
                                  this row (parameter iteration loop).
+  serve_churn_ivf_pq_100k        raft_tpu.stream churn row: closed-loop
+                                 mixed read/write load on a
+                                 MutableIndex(ivf_pq) — p50/p99 search
+                                 latency + write throughput under sustained
+                                 upsert+delete, >= 2 mid-load compaction
+                                 swaps with zero failed queries
+                                 (churn.failed == 0), mid-churn recall@10
+                                 within 0.01 of a fresh-oracle build
+                                 (recall_gap), and zero cold compiles on
+                                 the search hot path (churn.compile_s == 0,
+                                 rehearsal-warmed). `--serve-churn` runs
+                                 ONLY this row.
   ivf_flat_1m_p8                 IVF-Flat on the isotropic clustered 1M set
   cagra_1m_itopk32               CAGRA on the same set
 
@@ -701,6 +713,218 @@ def _row_serve(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     })
 
 
+def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
+                     n_probes=8, threads=8, writer_steps=64,
+                     upserts_per_step=96, deletes_per_step=32,
+                     delta_capacity=4096, compact_fill=0.75,
+                     max_batch=64, max_wait_us=2000.0, ncl=2000,
+                     n_eval=512):
+    """Mutable-index churn A/B (raft_tpu.stream, ISSUE 5): closed-loop
+    mixed read/write load on MutableIndex(ivf_pq) at 100k — reader threads
+    search through SearchService while a writer upserts + deletes and the
+    compactor folds the delta into the sealed index mid-load (>= 2 swaps).
+
+    Four claims ride in the row (the ISSUE 5 acceptance set):
+    - **zero failed/dropped queries** across the whole churn window,
+      compaction swaps included (``churn.failed == 0``);
+    - **mid-churn recall parity**: recall@10 of the live mutable index
+      (measured through the service, at warmed bucket shapes, right after
+      the first compaction) within 0.01 of a fresh oracle ivf_pq build over
+      exactly the live rows at that instant (``recall_gap``);
+    - **write throughput** (``write_rows_per_s``) alongside p50/p99 search
+      latency — the mixed-load numbers a capacity plan needs;
+    - **zero cold compiles on the search hot path**: the whole loaded
+      window — reads, writes, both compaction folds, the publish warms and
+      flips — runs under obs compile attribution and must report
+      ``compile_s == 0`` / ``cache_misses == 0``. The compaction-epoch
+      programs are compiled beforehand by a REHEARSAL of the same
+      (deterministic) write schedule against a throwaway wrapper of the
+      same sealed index — the production analogue of provisioning warmup
+      (docs/warm_builds.md): the write schedule alone determines every
+      post-compaction shape, so the rehearsal compiles exactly the program
+      set the live window replays, and the attribution then PROVES the
+      swaps and the hot path are compile-free.
+
+    The writer triggers ``Compactor.run_once`` synchronously at the
+    delta-fill watermark (writer-driven rather than the background poll
+    thread, so fold sizes are schedule-deterministic and the rehearsal's
+    shapes match); the background-thread mode is covered by
+    tests/test_stream.py."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.neighbors.brute_force import knn
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.serve import IndexRegistry, SearchService
+
+    total_upserts = writer_steps * upserts_per_step
+    total_deletes = writer_steps * deletes_per_step
+    assert total_deletes < n, "delete schedule exceeds the dataset"
+
+    _note("churn: dataset")
+    dataset, qsets = _make_clustered(n + total_upserts, d, max(n_eval, 1000),
+                                     ncl, n_qsets=1, seed=13)
+    jax.block_until_ready([dataset] + qsets)
+    x_host = np.asarray(dataset[:n])
+    churn_host = np.asarray(dataset[n:])  # the upsert pool, same distribution
+    pool = np.asarray(qsets[0])
+    eval_q = pool[:n_eval]
+
+    _note("churn: ivf_pq build")
+    t0 = time.perf_counter()
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim,
+                                seed=0)
+    idx = ivf_pq.build(params, dataset[:n])
+    jax.block_until_ready(idx.list_codes)
+    build_s = time.perf_counter() - t0
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+
+    policy = stream.CompactionPolicy(delta_fill=compact_fill,
+                                     tombstone_ratio=None, max_age_s=None)
+
+    def write_schedule(mutable, comp, on_step=None):
+        """The deterministic churn schedule — run once as the rehearsal and
+        once for real. Returns (#compactions, list of compaction reports)."""
+        reports = []
+        for step in range(writer_steps):
+            lo = step * upserts_per_step
+            mutable.upsert(churn_host[lo:lo + upserts_per_step],
+                           ids=n + np.arange(lo, lo + upserts_per_step))
+            dlo = step * deletes_per_step
+            mutable.delete(np.arange(dlo, dlo + deletes_per_step))
+            while comp.due():
+                reports.append(comp.run_once())
+            if on_step is not None:
+                on_step(step, len(reports))
+        return reports
+
+    # ---- rehearsal: compile every compaction-epoch program off-line ------
+    _note("churn: rehearsal (compiles the epoch program set)")
+    from raft_tpu.serve import bucket_sizes
+
+    m0 = stream.MutableIndex(idx, search_params=sp, retain_vectors=False,
+                             delta_capacity=delta_capacity, name="rehearsal")
+    reg0 = IndexRegistry(buckets=bucket_sizes(max_batch))
+    reg0.publish("churn-rehearsal", m0, k=k)
+    m0.warm(reg0.buckets, ks=(k,))
+    comp0 = stream.Compactor(m0, publisher=reg0, name="churn-rehearsal",
+                             ks=(k,), policy=policy)
+    rehearsal_reports = write_schedule(m0, comp0)
+    del m0, comp0, reg0
+
+    # ---- the real, attributed window -------------------------------------
+    _note("churn: live window, %d reader threads" % threads)
+    m = stream.MutableIndex(idx, search_params=sp, retain_vectors=False,
+                            delta_capacity=delta_capacity, name="churn")
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max(4 * max_batch * threads, 256))
+    svc.publish("churn", m, k=k)
+    m.warm(svc.buckets, ks=(k,))
+    comp = stream.Compactor(m, publisher=svc, name="churn", ks=(k,),
+                            policy=policy)
+
+    done = threading.Event()
+    lats, failures, served = [], [], [0]
+    lock = threading.Lock()
+    eval_box = {}
+
+    def reader(tid):
+        my_lats, j = [], 0
+        while not done.is_set():
+            qi = (tid + j * threads) % pool.shape[0]
+            j += 1
+            t0 = time.perf_counter()
+            try:
+                svc.search("churn", pool[qi:qi + 1], k)
+            except Exception as e:  # pragma: no cover - any loss fails the row
+                with lock:
+                    failures.append(f"{type(e).__name__}: {str(e)[:80]}")
+                continue
+            my_lats.append(time.perf_counter() - t0)
+        with lock:
+            lats.extend(my_lats)
+            served[0] += len(my_lats)
+
+    def on_step(step, n_compactions):
+        # mid-churn recall snapshot: right after the schedule's midpoint
+        # (past the first compaction), query the service at warmed bucket
+        # shapes and record the exact live-set bookkeeping for the oracle
+        if step == writer_steps // 2 and "ids" not in eval_box:
+            got = []
+            for lo in range(0, n_eval, max_batch):
+                _, ids = svc.search("churn", eval_q[lo:lo + max_batch], k)
+                got.append(np.asarray(ids))
+            eval_box["ids"] = np.concatenate(got)
+            eval_box["del_done"] = (step + 1) * deletes_per_step
+            eval_box["ins_done"] = (step + 1) * upserts_per_step
+            eval_box["compactions_at_eval"] = n_compactions
+
+    with obs_compile.attribution() as rec:
+        workers = [threading.Thread(target=reader, args=(t,))
+                   for t in range(threads)]
+        t_load = time.perf_counter()
+        for w in workers:
+            w.start()
+        t_write = time.perf_counter()
+        reports = write_schedule(m, comp, on_step)
+        write_s = time.perf_counter() - t_write
+        done.set()
+        for w in workers:
+            w.join(600)
+        load_s = time.perf_counter() - t_load
+    svc.shutdown()
+
+    # ---- oracle: fresh build over the mid-churn live rows ----------------
+    _note("churn: fresh-oracle build over the mid-churn live set")
+    del_done, ins_done = eval_box["del_done"], eval_box["ins_done"]
+    live_mat = np.concatenate([x_host[del_done:], churn_host[:ins_done]])
+    live_gids = np.concatenate([np.arange(del_done, n),
+                                n + np.arange(ins_done)])
+    _, gt_pos = knn(live_mat, eval_q, k)
+    gt_gids = live_gids[np.asarray(gt_pos)]
+    recall_mut = _recall(eval_box["ids"], gt_gids)
+    oracle = ivf_pq.build(params, live_mat)
+    jax.block_until_ready(oracle.list_codes)
+    _, o_pos = ivf_pq.search(sp, oracle, eval_q, k)
+    o_pos = np.asarray(o_pos)
+    oracle_gids = np.where(o_pos >= 0, live_gids[np.clip(o_pos, 0, None)], -1)
+    recall_oracle = _recall(oracle_gids, gt_gids)
+
+    lats_ms = np.sort(np.array(lats if lats else [0.0])) * 1e3
+    rows.append({
+        "name": "serve_churn_ivf_pq_100k",
+        "qps": round(served[0] / load_s, 1),
+        "p50_ms": round(float(lats_ms[len(lats_ms) // 2]), 3),
+        "p99_ms": round(float(lats_ms[int(len(lats_ms) * 0.99) - 1]), 3),
+        "write_rows_per_s": round(
+            (total_upserts + total_deletes) / write_s, 1),
+        "recall_mut": round(recall_mut, 4),
+        "recall_oracle": round(recall_oracle, 4),
+        "recall_gap": round(recall_mut - recall_oracle, 4),
+        "build_s": round(build_s, 1),
+        "threads": threads, "max_batch": max_batch,
+        "delta_capacity": delta_capacity,
+        "churn": {
+            "failed": len(failures),
+            "compactions": len(reports),
+            "compaction_wall_s": [r["wall_s"] for r in reports],
+            "folded_rows": [r["folded"] for r in reports],
+            "upserts": total_upserts, "deletes": total_deletes,
+            # zero-cold-compile proof for the WHOLE loaded window (both
+            # folds, their publish warms + flips, every flush): the
+            # rehearsal pre-compiled the epoch program set, so a non-zero
+            # value here means something compiled ON the serving path
+            "compile_s": round(rec.compile_s, 3),
+            "cache_misses": rec.cache_misses,
+        },
+        "failures": failures[:5],
+    })
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -918,6 +1142,11 @@ def _run(rows):
         _row_guard(rows, "serve_ivf_pq_100k", lambda: _row_serve(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "serve_churn_ivf_pq_100k",
+                   lambda: _row_serve_churn(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -985,7 +1214,13 @@ def main(argv=None):
     except (ValueError, OSError):  # pragma: no cover - non-main thread
         pass
     try:
-        if "--serve" in argv:
+        if "--serve-churn" in argv:
+            # mutable-lifecycle churn row only (ISSUE 5): the quick loop
+            # for iterating on stream/compactor parameters
+            _setup(rows)
+            _row_guard(rows, "serve_churn_ivf_pq_100k",
+                       lambda: _row_serve_churn(rows))
+        elif "--serve" in argv:
             # serving-layer A/B only (ISSUE 3): the quick loop for
             # iterating on batcher/registry parameters
             _setup(rows)

@@ -29,6 +29,9 @@ Subpackages (lazily imported):
   serve      online serving: micro-batching, versioned hot-swap registry,
              admission control                                 (no ref counterpart — SURVEY §5
                                                                 leaves scheduling to the user)
+  stream     mutable index lifecycle: delta memtable, tombstone
+             deletes, background compaction with warm hot-swap (no ref counterpart —
+                                                                FreshDiskANN-style fresh/sealed split)
 """
 
 import importlib
@@ -55,6 +58,7 @@ _SUBMODULES = {
     "parallel",
     "serve",
     "spatial",
+    "stream",
     "config",
 }
 

@@ -42,19 +42,24 @@ __all__ = [
 #   raft_tpu/6: ivf_pq + cagra carry data_kind (int8/uint8 byte datasets).
 #   raft_tpu/7: ivf_pq carries list_scales (per-list residual scale
 #       normalization, IndexParams.residual_scale_norm).
-SERIALIZATION_VERSION = "raft_tpu/7"
+#   raft_tpu/8: new "stream" section (raft_tpu.stream.MutableIndex — sealed
+#       index + delta memtable + tombstones in one file) and a "brute_force"
+#       section (the stream wrapper's simplest sealed kind); the
+#       ivf_flat/ivf_pq/cagra layouts are unchanged from /7.
+SERIALIZATION_VERSION = "raft_tpu/8"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
 # must not force rebuilds of unchanged formats; loaders branch on the
-# returned version where a field was added).
+# returned version where a field was added). "stream"/"brute_force" are new
+# in /8, so they have no older layouts to accept.
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                           "raft_tpu/5", "raft_tpu/6"}),
+                           "raft_tpu/5", "raft_tpu/6", "raft_tpu/7"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
-                         "raft_tpu/6"}),
+                         "raft_tpu/6", "raft_tpu/7"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                        "raft_tpu/5", "raft_tpu/6"}),
+                        "raft_tpu/5", "raft_tpu/6", "raft_tpu/7"}),
 }
 
 

@@ -315,6 +315,45 @@ class BruteForce:
         return knn(self.dataset, queries, k, self.metric, self.metric_arg, res=res)
 
 
+def write_index(f, index: BruteForce) -> None:
+    """Serialize to an open binary stream (new in raft_tpu/8 — the
+    brute-force index is the stream wrapper's simplest sealed kind, so it
+    needs the same composable serialization as the ANN indexes; reference:
+    brute_force::index stores dataset + metric, brute_force_types.hpp)."""
+    from ..core.serialize import serialize_header, serialize_mdspan, serialize_scalar
+
+    expects(index.dataset is not None, "index is not built")
+    serialize_header(f, "brute_force")
+    serialize_scalar(f, int(resolve_metric(index.metric)))
+    serialize_scalar(f, float(index.metric_arg))
+    serialize_mdspan(f, index.dataset)
+
+
+def read_index(f) -> BruteForce:
+    """Deserialize from an open binary stream (pairs with
+    :func:`write_index`)."""
+    import jax.numpy as jnp
+
+    from ..core.serialize import check_header, deserialize_mdspan, deserialize_scalar
+
+    check_header(f, "brute_force")
+    metric = DistanceType(deserialize_scalar(f))
+    metric_arg = float(deserialize_scalar(f))
+    idx = BruteForce(metric=metric, metric_arg=metric_arg)
+    idx.dataset = jnp.asarray(deserialize_mdspan(f))
+    return idx
+
+
+def save(index: BruteForce, path: str) -> None:
+    with open(path, "wb") as f:
+        write_index(f, index)
+
+
+def load(path: str, res: Resources | None = None) -> BruteForce:
+    with open(path, "rb") as f:
+        return read_index(f)
+
+
 def batched_searcher(index: BruteForce, params=None):
     """Stable serving hook (raft_tpu.serve; contract in
     :mod:`._hooks`): ``fn(queries, k) -> (distances, ids)`` with

@@ -583,7 +583,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 )
 def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
                   max_iter: int, search_width: int, sqrt_out: bool,
-                  seed_pool: int = 16384, hop_impl: str = "xla"):
+                  seed_pool: int = 16384, hop_impl: str = "xla",
+                  keep_mask=None):
     n, d = index.dataset.shape
     m = queries.shape[0]
     deg = index.graph_degree
@@ -610,6 +611,11 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         pool_d = dn2[pool_ids][None, :] - 2.0 * jnp.einsum(
             "md,sd->ms", qf, pool_vecs, precision=lax.Precision.DEFAULT
         )  # (m, S)
+        if keep_mask is not None:
+            # mask the entry pool too: the n_init seeds must be the best
+            # SURVIVING pool candidates, or a heavy filter could leave a
+            # query with an all-filtered beam while kept rows exist
+            pool_d = jnp.where(keep_mask[pool_ids][None, :], pool_d, jnp.inf)
         _, best = lax.top_k(-pool_d, n_init)
         init_ids = pool_ids[best]  # (m, n_init), per-query seeds
         # re-score selected seeds exactly: the bf16 pool scores only pick
@@ -620,6 +626,15 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         init_ids = jax.random.choice(key, n, (n_init,), replace=False)
         init_ids = jnp.broadcast_to(init_ids[None, :], (m, n_init)).astype(jnp.int32)
         init_d = dist_to(qf, init_ids)
+    if keep_mask is not None:
+        # mask epilogue on the entry candidates (same contract as the
+        # ivf_pq/ivf_flat scan epilogues): filtered seeds carry +inf scores
+        # and can never win a beam slot. Like those scans — and unlike
+        # FreshDiskANN's traverse-through-deletes — filtered nodes are not
+        # expanded either (each hop's candidates are masked below), so heavy
+        # filtering should widen itopk to keep beam coverage.
+        init_d = jnp.where(jnp.take(keep_mask, init_ids, axis=0),
+                           init_d, jnp.inf)
 
     pad = itopk + exp_per_hop - n_init
     beam_ids = jnp.pad(init_ids, ((0, 0), (0, max(pad, 0))), constant_values=-1)[:, : itopk + exp_per_hop]
@@ -687,6 +702,12 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
             # INSIDE the kernel at the tile level (exact for 8-bit values)
             vecs = data[jnp.maximum(nbrs, 0)]
             valid = jnp.repeat(1 - nocand, deg, axis=1)  # per-candidate
+            if keep_mask is not None:
+                # filtered candidates ride the kernel's existing validity
+                # lane — masked before the in-VMEM merge/select, zero extra
+                # kernel passes
+                valid = valid * jnp.take(
+                    keep_mask, jnp.maximum(nbrs, 0), axis=0).astype(jnp.int32)
             bd, bi, bv, pick, nocand = cagra_hop(
                 qf, bd, bi, bv, nbrs, vecs, valid, itopk, width,
                 interpret=interpret, merge=merge)
@@ -703,7 +724,9 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         out_d = jnp.maximum(bd[:, :k], 0.0)
         if sqrt_out:
             out_d = jnp.sqrt(out_d)
-        return out_d, bi[:, :k]
+        # slots the (possibly filtered) beam never filled report the shared
+        # empty-slot sentinel: id -1 with the +inf score already in place
+        return out_d, jnp.where(jnp.isinf(out_d), -1, bi[:, :k])
 
     def cond(state):
         _, _, visited, it, done = state
@@ -725,7 +748,12 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
         safe_pick = jnp.maximum(pick_ids, 0)
         nbrs = index.graph[safe_pick].reshape(m, exp_per_hop)  # (m, w*deg)
         nbrs = jnp.where(pick_ids.repeat(deg, axis=1) >= 0, nbrs, -1)
-        nd = jnp.where(nbrs >= 0, dist_to(qf, jnp.maximum(nbrs, 0)), jnp.inf)
+        ok = nbrs >= 0
+        if keep_mask is not None:
+            # candidate mask epilogue: filtered expansions score +inf before
+            # the beam merge select (the ivf scan epilogue contract)
+            ok = ok & jnp.take(keep_mask, jnp.maximum(nbrs, 0), axis=0)
+        nd = jnp.where(ok, dist_to(qf, jnp.maximum(nbrs, 0)), jnp.inf)
 
         # merge expansions into the beam tail, re-sort, dedup
         ids = ids.at[:, itopk:].set(nbrs)
@@ -744,7 +772,9 @@ def _cagra_search(index: CagraIndex, queries, key, k: int, itopk: int,
     out_d = jnp.maximum(out_d, 0.0)
     if sqrt_out:
         out_d = jnp.sqrt(out_d)
-    return out_d, beam_ids[:, :k]
+    # slots the (possibly filtered) beam never filled report the shared
+    # empty-slot sentinel: id -1 with the +inf score already in place
+    return out_d, jnp.where(jnp.isinf(out_d), -1, beam_ids[:, :k])
 
 
 def resolve_max_iterations(params: SearchParams) -> int:
@@ -803,10 +833,22 @@ def resolve_hop_impl(params: SearchParams, graph_degree: int, dim: int,
                           "itopk": (a[0] if a else kw["params"]).itopk_size},
 )
 @auto_convert_output
-def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resources | None = None):
+def search(params: SearchParams, index: CagraIndex, queries, k: int,
+           sample_filter=None, res: Resources | None = None):
     """Batch-synchronous beam search (reference: cagra::search,
-    cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD)."""
+    cagra_search.cuh:70; SINGLE_CTA persistent kernel re-shaped for SPMD).
+
+    ``sample_filter`` is an optional
+    :class:`~raft_tpu.neighbors.sample_filter.BitsetFilter` / boolean
+    keep-mask over dataset rows — the same ``resolve_filter`` /
+    ``validate_filter_covers`` contract as ivf_pq/ivf_flat: filtered
+    candidates take +inf scores in the mask epilogue BEFORE the beam select,
+    and slots the filtered beam cannot fill report id -1 with +inf distance.
+    Filtered nodes are also not expanded (unlike FreshDiskANN's
+    traverse-through-deletes), so at heavy filter ratios widen
+    ``itopk_size`` to preserve recall."""
     from .brute_force import _coerce_queries
+    from .sample_filter import resolve_filter, validate_filter_covers
 
     res = res or default_resources()
     queries = jnp.asarray(queries)
@@ -822,38 +864,54 @@ def search(params: SearchParams, index: CagraIndex, queries, k: int, res: Resour
     pool = resolve_seed_pool(params, index.seed_pool_hint)
     impl = resolve_hop_impl(params, index.graph_degree, index.dim,
                             itemsize=index.dataset.dtype.itemsize)
+    keep_mask = resolve_filter(sample_filter)
+    if keep_mask is not None:
+        validate_filter_covers(index, keep_mask)
     return _cagra_search(index, queries, as_key(params.seed), int(k),
                          int(itopk), int(max_iter),
-                         int(params.search_width), sqrt_out, pool, impl)
+                         int(params.search_width), sqrt_out, pool, impl,
+                         keep_mask)
+
+
+def write_index(f, index: CagraIndex) -> None:
+    """Serialize to an open binary stream (the composable half of
+    :func:`save` — :mod:`raft_tpu.stream` embeds sealed indexes this way)."""
+    serialize_header(f, "cagra")
+    serialize_scalar(f, int(index.metric))
+    serialize_scalar(f, int(index.seed_pool_hint))
+    serialize_scalar(f, index.data_kind)
+    serialize_mdspan(f, index.dataset)
+    serialize_mdspan(f, index.graph)
+
+
+def read_index(f) -> CagraIndex:
+    """Deserialize from an open binary stream (pairs with
+    :func:`write_index`)."""
+    ver = check_header(f, "cagra")
+    metric = DistanceType(deserialize_scalar(f))
+    # raft_tpu/4 added the measured seed_pool_hint; older files search
+    # with the default pool (correct, just not data-tuned)
+    hint = deserialize_scalar(f) if ver not in (
+        "raft_tpu/2", "raft_tpu/3") else 0
+    # raft_tpu/6 added data_kind (byte datasets); older files could
+    # only hold float data
+    kind = deserialize_scalar(f) if ver not in (
+        "raft_tpu/2", "raft_tpu/3", "raft_tpu/4", "raft_tpu/5") else "float32"
+    dataset = jnp.asarray(deserialize_mdspan(f))
+    graph = jnp.asarray(deserialize_mdspan(f))
+    return CagraIndex(dataset=dataset, graph=graph, metric=metric,
+                      data_kind=kind, seed_pool_hint=hint)
 
 
 def save(index: CagraIndex, path: str) -> None:
     """Serialize (reference: cagra_serialize.cuh)."""
     with open(path, "wb") as f:
-        serialize_header(f, "cagra")
-        serialize_scalar(f, int(index.metric))
-        serialize_scalar(f, int(index.seed_pool_hint))
-        serialize_scalar(f, index.data_kind)
-        serialize_mdspan(f, index.dataset)
-        serialize_mdspan(f, index.graph)
+        write_index(f, index)
 
 
 def load(path: str, res: Resources | None = None) -> CagraIndex:
     with open(path, "rb") as f:
-        ver = check_header(f, "cagra")
-        metric = DistanceType(deserialize_scalar(f))
-        # raft_tpu/4 added the measured seed_pool_hint; older files search
-        # with the default pool (correct, just not data-tuned)
-        hint = deserialize_scalar(f) if ver not in (
-            "raft_tpu/2", "raft_tpu/3") else 0
-        # raft_tpu/6 added data_kind (byte datasets); older files could
-        # only hold float data
-        kind = deserialize_scalar(f) if ver not in (
-            "raft_tpu/2", "raft_tpu/3", "raft_tpu/4", "raft_tpu/5") else "float32"
-        dataset = jnp.asarray(deserialize_mdspan(f))
-        graph = jnp.asarray(deserialize_mdspan(f))
-    return CagraIndex(dataset=dataset, graph=graph, metric=metric,
-                      data_kind=kind, seed_pool_hint=hint)
+        return read_index(f)
 
 
 def batched_searcher(index: CagraIndex, params: SearchParams | None = None):

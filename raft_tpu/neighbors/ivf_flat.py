@@ -486,42 +486,54 @@ def search(params: SearchParams, index: IvfFlatIndex, queries, k: int,
                        index.metric, keep_mask)
 
 
+def write_index(f, index: IvfFlatIndex) -> None:
+    """Serialize to an open binary stream (the composable half of
+    :func:`save` — :mod:`raft_tpu.stream` embeds sealed indexes this way)."""
+    serialize_header(f, "ivf_flat")
+    serialize_scalar(f, int(index.metric))
+    serialize_scalar(f, float(index.split_factor))
+    serialize_scalar(f, index.data_kind)
+    serialize_mdspan(f, index.centers)
+    serialize_mdspan(f, index.list_data)
+    serialize_mdspan(f, index.list_ids)
+    serialize_mdspan(f, index.list_norms)
+    serialize_mdspan(f, index.list_sizes)
+
+
+def read_index(f) -> IvfFlatIndex:
+    """Deserialize from an open binary stream (pairs with
+    :func:`write_index`)."""
+    ver = check_header(f, "ivf_flat")
+    metric = DistanceType(deserialize_scalar(f))
+    split_factor = float(deserialize_scalar(f))
+    # raft_tpu/5 added data_kind (int8/uint8 storage); older files —
+    # including /4, whose global bump was for cagra and wrote ivf_flat
+    # in the /3 layout — hold only float kinds, recoverable from the
+    # stored dtype
+    kind = (deserialize_scalar(f)
+            if ver not in ("raft_tpu/2", "raft_tpu/3", "raft_tpu/4")
+            else None)
+    centers = jnp.asarray(deserialize_mdspan(f))
+    data = jnp.asarray(deserialize_mdspan(f))
+    ids = jnp.asarray(deserialize_mdspan(f))
+    norms = jnp.asarray(deserialize_mdspan(f))
+    sizes = jnp.asarray(deserialize_mdspan(f))
+    if kind is None:
+        kind = "bfloat16" if data.dtype == jnp.bfloat16 else "float32"
+    return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor,
+                        kind)
+
+
 def save(index: IvfFlatIndex, path: str) -> None:
     """Serialize (reference: ivf_flat_serialize.cuh; pylibraft save)."""
     with open(path, "wb") as f:
-        serialize_header(f, "ivf_flat")
-        serialize_scalar(f, int(index.metric))
-        serialize_scalar(f, float(index.split_factor))
-        serialize_scalar(f, index.data_kind)
-        serialize_mdspan(f, index.centers)
-        serialize_mdspan(f, index.list_data)
-        serialize_mdspan(f, index.list_ids)
-        serialize_mdspan(f, index.list_norms)
-        serialize_mdspan(f, index.list_sizes)
+        write_index(f, index)
 
 
 def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
     """Deserialize (reference: ivf_flat_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        ver = check_header(f, "ivf_flat")
-        metric = DistanceType(deserialize_scalar(f))
-        split_factor = float(deserialize_scalar(f))
-        # raft_tpu/5 added data_kind (int8/uint8 storage); older files —
-        # including /4, whose global bump was for cagra and wrote ivf_flat
-        # in the /3 layout — hold only float kinds, recoverable from the
-        # stored dtype
-        kind = (deserialize_scalar(f)
-                if ver not in ("raft_tpu/2", "raft_tpu/3", "raft_tpu/4")
-                else None)
-        centers = jnp.asarray(deserialize_mdspan(f))
-        data = jnp.asarray(deserialize_mdspan(f))
-        ids = jnp.asarray(deserialize_mdspan(f))
-        norms = jnp.asarray(deserialize_mdspan(f))
-        sizes = jnp.asarray(deserialize_mdspan(f))
-    if kind is None:
-        kind = "bfloat16" if data.dtype == jnp.bfloat16 else "float32"
-    return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor,
-                        kind)
+        return read_index(f)
 
 
 def batched_searcher(index: IvfFlatIndex, params: SearchParams | None = None):

@@ -1389,47 +1389,59 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
     )
 
 
+def write_index(f, index: IvfPqIndex) -> None:
+    """Serialize to an open binary stream (the composable half of
+    :func:`save` — :mod:`raft_tpu.stream` embeds sealed indexes this way)."""
+    serialize_header(f, "ivf_pq")
+    serialize_scalar(f, int(index.metric))
+    serialize_scalar(f, index.codebook_kind)
+    serialize_scalar(f, index.pq_bits)
+    serialize_scalar(f, float(index.split_factor))
+    serialize_scalar(f, bool(index.pq_split))
+    serialize_scalar(f, index.data_kind)
+    for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
+                index.list_codes, index.list_ids, index.list_sizes,
+                index.list_consts, index.list_scales):
+        serialize_mdspan(f, arr)
+
+
+def read_index(f) -> IvfPqIndex:
+    """Deserialize from an open binary stream (pairs with
+    :func:`write_index`)."""
+    ver = check_header(f, "ivf_pq")
+    metric = DistanceType(deserialize_scalar(f))
+    codebook_kind = deserialize_scalar(f)
+    pq_bits = deserialize_scalar(f)
+    split_factor = float(deserialize_scalar(f))
+    pq_split = bool(deserialize_scalar(f))
+    # raft_tpu/6 added data_kind (int8/uint8 byte ingestion); older
+    # files could only hold float data
+    kind = (deserialize_scalar(f)
+            if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5")
+            else "float32")
+    arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(8)]
+    # raft_tpu/7 added list_scales (residual_scale_norm); older files
+    # never normalized, so the disabled (0,) sentinel is exact
+    if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
+                   "raft_tpu/6"):
+        arrs.append(jnp.asarray(deserialize_mdspan(f)))
+    else:
+        arrs.append(jnp.zeros((0,), jnp.float32))
+    return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
+                      split_factor=split_factor, pq_split=pq_split,
+                      data_kind=kind)
+
+
 def save(index: IvfPqIndex, path: str) -> None:
     """Serialize (reference: ivf_pq_serialize.cuh:52-110)."""
     with open(path, "wb") as f:
-        serialize_header(f, "ivf_pq")
-        serialize_scalar(f, int(index.metric))
-        serialize_scalar(f, index.codebook_kind)
-        serialize_scalar(f, index.pq_bits)
-        serialize_scalar(f, float(index.split_factor))
-        serialize_scalar(f, bool(index.pq_split))
-        serialize_scalar(f, index.data_kind)
-        for arr in (index.centers, index.centers_rot, index.rotation, index.codebooks,
-                    index.list_codes, index.list_ids, index.list_sizes,
-                    index.list_consts, index.list_scales):
-            serialize_mdspan(f, arr)
+        write_index(f, index)
 
 
 def load(path: str, res: Resources | None = None) -> IvfPqIndex:
     """Deserialize (reference: ivf_pq_serialize.cuh deserialize)."""
     with open(path, "rb") as f:
-        ver = check_header(f, "ivf_pq")
-        metric = DistanceType(deserialize_scalar(f))
-        codebook_kind = deserialize_scalar(f)
-        pq_bits = deserialize_scalar(f)
-        split_factor = float(deserialize_scalar(f))
-        pq_split = bool(deserialize_scalar(f))
-        # raft_tpu/6 added data_kind (int8/uint8 byte ingestion); older
-        # files could only hold float data
-        kind = (deserialize_scalar(f)
-                if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5")
-                else "float32")
-        arrs = [jnp.asarray(deserialize_mdspan(f)) for _ in range(8)]
-        # raft_tpu/7 added list_scales (residual_scale_norm); older files
-        # never normalized, so the disabled (0,) sentinel is exact
-        if ver not in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
-                       "raft_tpu/6"):
-            arrs.append(jnp.asarray(deserialize_mdspan(f)))
-        else:
-            arrs.append(jnp.zeros((0,), jnp.float32))
-    return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
-                      split_factor=split_factor, pq_split=pq_split,
-                      data_kind=kind)
+        return read_index(f)
 
 
 def batched_searcher(index: IvfPqIndex, params: SearchParams | None = None):

@@ -39,14 +39,17 @@ def resolve_filter(f):
 
 
 def validate_filter_covers(index, keep_mask) -> None:
-    """Check the keep-mask covers every stored id. The max stored id needs a
-    device reduction + host sync, so it is memoized on the index instance
-    (invalidated by extend(), which returns a new index object)."""
+    """Check the keep-mask covers every stored id. IVF indexes hold explicit
+    ``list_ids`` whose max needs a device reduction + host sync, so it is
+    memoized on the index instance (invalidated by extend(), which returns a
+    new index object); dense row-id indexes (cagra, whose stored ids ARE the
+    dataset row offsets) cover ``[0, size)`` by construction."""
     from ..core.errors import expects
 
     max_id = getattr(index, "_max_id_cache", None)
     if max_id is None:
-        max_id = int(jnp.max(index.list_ids))
+        ids = getattr(index, "list_ids", None)
+        max_id = index.size - 1 if ids is None else int(jnp.max(ids))
         index._max_id_cache = max_id
     expects(
         keep_mask.shape[0] > max_id,

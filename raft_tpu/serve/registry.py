@@ -67,9 +67,17 @@ def _versions_live():
 def make_searcher(index, search_params=None) -> Callable:
     """Resolve an index object to its module's ``batched_searcher`` hook:
     a ``fn(queries, k) -> (distances, ids)`` closure carrying ``.kind``,
-    ``.dim`` and ``.query_dtype`` attributes. Raises for unknown types."""
+    ``.dim`` and ``.query_dtype`` attributes. Raises for unknown types.
+    A :class:`raft_tpu.stream.MutableIndex` (duck-typed, so serve never
+    imports stream) resolves to its current-epoch searcher — its search
+    params were baked in at wrap time."""
     from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
 
+    if hasattr(index, "upsert") and hasattr(index, "searcher"):
+        expects(search_params is None,
+                "a MutableIndex bakes its search params at wrap time; "
+                "search_params here would be silently ignored")
+        return index.searcher()
     for mod, cls in ((brute_force, brute_force.BruteForce),
                      (ivf_flat, ivf_flat.IvfFlatIndex),
                      (ivf_pq, ivf_pq.IvfPqIndex),
@@ -78,7 +86,8 @@ def make_searcher(index, search_params=None) -> Callable:
             return mod.batched_searcher(index, search_params)
     raise RaftError(
         f"no serving hook for index type {type(index).__name__!r} "
-        "(expected BruteForce, IvfFlatIndex, IvfPqIndex or CagraIndex)")
+        "(expected BruteForce, IvfFlatIndex, IvfPqIndex, CagraIndex or "
+        "stream.MutableIndex)")
 
 
 @dataclass
@@ -110,8 +119,9 @@ class IndexRegistry:
         self._versions: dict[str, list[_Version]] = {}
         # publishes serialize PER NAME (warm-then-flip must not interleave
         # for one name), but a slow warm of one index must not block an
-        # urgent hot-swap of another
-        self._publish_locks: dict[str, threading.Lock] = {}
+        # urgent hot-swap of another; reentrant so service-layer wrappers
+        # can hold it around publish() (see publish_lock)
+        self._publish_locks: dict[str, threading.RLock] = {}
 
     # -- publish / swap -----------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
@@ -146,9 +156,7 @@ class IndexRegistry:
         else:
             searcher = make_searcher(index, search_params)
         ks = (k,) if isinstance(k, int) else tuple(k)
-        with self._lock:
-            plock = self._publish_locks.setdefault(name, threading.Lock())
-        with plock:
+        with self.publish_lock(name):
             # a replacement must preserve the stream contract: batchers pin
             # (d, dtype) per stream and queued requests flush on the version
             # active at drain, so a dim/dtype-changing republish would fail
@@ -205,6 +213,15 @@ class IndexRegistry:
                 self._retire(dead)
             report["version"] = v.version
             return report
+
+    def publish_lock(self, name: str) -> threading.RLock:
+        """The per-name publish serialization lock (reentrant — publish()
+        takes it itself). Wrappers that keep name-keyed state consistent
+        with the flip (e.g. SearchService's write-path handles) hold it
+        AROUND their publish() call so no concurrent publish can interleave
+        between the flip and their bookkeeping."""
+        with self._lock:
+            return self._publish_locks.setdefault(name, threading.RLock())
 
     def _retire(self, v: _Version) -> None:
         # drop the searcher closure — it owns the only registry reference

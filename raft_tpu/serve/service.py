@@ -128,6 +128,9 @@ class SearchService:
         # across an enqueue
         self._lock = threading.Lock()
         self._batchers: dict[tuple, MicroBatcher] = {}
+        # writable (stream.MutableIndex) handles per name — the write path
+        # (upsert/delete) routes through these
+        self._mutables: dict[str, object] = {}
         self._closed = False
 
     # -- publish ------------------------------------------------------------
@@ -139,11 +142,36 @@ class SearchService:
         Safe under load: in-flight requests finish on the old version.
         ``warm_data`` (optional (rows, dim) sample in the serving dtype)
         draws the warmup queries from real data — see
-        :func:`raft_tpu._warmup.warm_buckets`."""
+        :func:`raft_tpu._warmup.warm_buckets`.
+
+        Publishing a :class:`raft_tpu.stream.MutableIndex` additionally
+        opens the WRITE path: :meth:`upsert`/:meth:`delete` on this name
+        route to it (re-publishing the index's ``searcher()`` hook — what a
+        ``stream.Compactor`` does after a swap — keeps the handle)."""
         with tracing.range("serve/publish/%s", name):
-            return self.registry.publish(
-                name, index, search_params=search_params, k=k,
-                version=version, warm=warm, warm_data=warm_data)
+            # hold the registry's per-name publish lock across flip AND
+            # handle bookkeeping: a concurrent publish to the same name
+            # could otherwise interleave between them and leave the write
+            # path routed to an index that lost the flip
+            with self.registry.publish_lock(name):
+                report = self.registry.publish(
+                    name, index, search_params=search_params, k=k,
+                    version=version, warm=warm, warm_data=warm_data)
+                with self._lock:
+                    mut = getattr(index, "mutable", None)
+                    if hasattr(index, "upsert") and hasattr(index, "searcher"):
+                        self._mutables[name] = index
+                    elif mut is not None and hasattr(mut, "upsert"):
+                        # a MutableIndex's OWN hook (marked by searcher() —
+                        # what a stream.Compactor republishes after each
+                        # swap): the write path follows it
+                        self._mutables[name] = mut
+                    else:
+                        # anything else — a plain index or an unmarked hook
+                        # — closes the write path: keeping a stale handle
+                        # would route upserts to an index nobody serves
+                        self._mutables.pop(name, None)
+            return report
 
     # -- serving ------------------------------------------------------------
     def _stream(self, name: str, k: int) -> MicroBatcher:
@@ -248,6 +276,40 @@ class SearchService:
         if metrics._enabled:
             _requests_total().inc(1, stream=f"{name}.k{k}")
         return fut
+
+    # -- write path (stream.MutableIndex names) -----------------------------
+    def _mutable(self, name: str):
+        if self._closed:
+            raise ServiceClosedError("service is shut down")
+        with self._lock:
+            m = self._mutables.get(name)
+        expects(m is not None,
+                "%r is not a mutable (stream) index — publish a "
+                "raft_tpu.stream.MutableIndex under this name to open the "
+                "write path", name)
+        return m
+
+    def upsert(self, name: str, rows, ids=None):
+        """Insert/upsert rows into the mutable index published under
+        ``name``; returns the global ids. Synchronous with read-your-writes
+        at the service boundary — when this returns, the rows win every
+        subsequent search, except during a compaction swap's publish window,
+        where flushes still leasing the pre-swap epoch serve its frozen view
+        for one flush (the swap staleness window, docs/streaming.md
+        "Consistency model"). The admission taxonomy matches :meth:`submit`:
+        :class:`ServiceClosedError` after shutdown, and a full delta
+        memtable raises :class:`raft_tpu.stream.DeltaFullError` — an
+        :class:`OverloadedError` — so callers shed write load exactly like
+        refused reads (attach a ``stream.Compactor`` to fold the delta
+        before the wall)."""
+        return self._mutable(name).upsert(rows, ids)
+
+    def delete(self, name: str, ids) -> int:
+        """Tombstone ids on the mutable index published under ``name``;
+        returns how many were live. Deletes are visible to the very next
+        search (read-your-writes; same one-flush swap-staleness caveat as
+        :meth:`upsert`); unknown ids are a counted no-op."""
+        return self._mutable(name).delete(ids)
 
     def search(self, name: str, queries, k: int = 10, *,
                timeout_s: float | None = None):

@@ -1,0 +1,38 @@
+"""raft_tpu.stream — mutable index lifecycle (delta memtable, tombstones,
+background compaction with warm hot-swap).
+
+The serve layer (PR 3) batches and hot-swaps immutable indexes; this layer
+makes the indexes themselves mutable under live traffic — the LSM-style
+fresh/sealed split of FreshDiskANN (Singh et al. 2021; PAPERS.md):
+
+- :class:`MutableIndex` — wraps any sealed index (brute-force / IVF-Flat /
+  IVF-PQ / CAGRA, float and byte dtypes): upserts land in a fixed-capacity
+  **delta memtable** scanned by the exact fused-kNN at power-of-two bucket
+  shapes; deletes flip **tombstone bitsets** applied through
+  ``sample_filter=`` on the sealed side and the scan mask on the delta
+  side; ``search()`` merges both through the existing ``select_k``
+  dispatch. Read-your-writes: a write is visible to the next search.
+- :class:`Compactor` — watermark-triggered (delta fill / tombstone ratio /
+  age) background folds: ``extend`` for IVF kinds, full rebuild to reclaim
+  tombstones, atomically swapped and republished through
+  :class:`raft_tpu.serve.IndexRegistry` so the serving hot path never sees
+  a cold program and in-flight leases drain on the old epoch.
+- :func:`save`/:func:`load` — the full mutable state (sealed + delta +
+  tombstones + id map) as one ``stream`` file section (raft_tpu/8).
+
+Worked example + consistency model: docs/streaming.md. Metrics
+(``raft_tpu_stream_*``): docs/observability.md. The serve write path
+(`SearchService.upsert/delete`) routes here: docs/serving.md.
+"""
+
+from . import compactor, mutable
+from .compactor import CompactionPolicy, Compactor
+from .mutable import (DELTA_MIN_BUCKET, DeltaFullError, MutableIndex,
+                      delta_buckets, load, save)
+
+__all__ = [
+    "mutable", "compactor",
+    "MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET", "delta_buckets",
+    "Compactor", "CompactionPolicy",
+    "save", "load",
+]

@@ -1,0 +1,890 @@
+"""MutableIndex: an LSM-style mutable lifecycle over any sealed ANN index.
+
+Every index in :mod:`raft_tpu.neighbors` is immutable-at-best after build
+(``extend`` appends; nothing deletes), yet a production corpus churns —
+live traffic upserts and deletes rows continuously. The standard answer is
+the fresh/sealed split of FreshDiskANN (Singh et al. 2021), which is the
+memtable/compaction shape of LSM-trees (O'Neil et al. 1996) applied to ANN:
+
+- **Delta memtable** — recent writes land in a fixed-capacity row buffer
+  scanned by the exact fused-kNN at serve time. The buffer is exposed to
+  the device at power-of-two *bucket* sizes (8, 16, ..., ``delta_capacity``
+  — the same shape discipline as :mod:`raft_tpu.serve.batcher`'s query
+  buckets), so delta growth never compiles on the hot path once
+  :meth:`MutableIndex.warm` has touched the ladder.
+- **Tombstone bitsets** — deletes flip per-slot alive bits: the sealed
+  index is filtered through its module's ``sample_filter=`` epilogue (the
+  reason every neighbors module grew one), the delta through the same mask
+  applied to its exact scan. ``upsert`` = tombstone-the-old-slot +
+  insert-new, so an id is live in exactly one physical slot at a time.
+- **Unified search** — sealed(filtered) and delta scans merge through the
+  existing ``select_k`` dispatch; slot-local ids translate to stable global
+  ids through a device-resident id map. Results are indistinguishable from
+  a fresh build over the live rows (bit-equal ids for exact sealed kinds,
+  recall-parity for quantized ones — pinned by ``tests/test_stream.py``).
+- **Compaction** (:mod:`raft_tpu.stream.compactor`) folds delta+tombstones
+  into a new sealed index off the hot path — ``extend`` where the sealed
+  kind supports it (IVF-Flat/IVF-PQ), full rebuild where it does not
+  (CAGRA, brute-force) or when tombstones must actually be reclaimed — and
+  swaps it in atomically. Writes that land during a fold are never lost:
+  the fold consumes a snapshot *prefix* of the append-only delta, the
+  remainder carries over, and the swap recomputes every alive bit from the
+  live tombstone state.
+
+Thread-safety: all mutations run under one lock; searches take a handle
+snapshot and run lock-free (device arrays are immutable once published to a
+state). :meth:`searcher` returns a serving hook pinned to the CURRENT state
+object, which is exactly what :class:`raft_tpu.serve.IndexRegistry` leases:
+after a compaction swap, in-flight flushes drain on the pinned (frozen)
+pre-compaction state while new flushes pick up the published successor.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from ..core.errors import RaftError, expects
+from ..distance.types import DistanceType, resolve_metric
+from ..obs import metrics
+from ..serve.errors import OverloadedError
+
+__all__ = ["MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET",
+           "delta_buckets", "save", "load"]
+
+# floor of the delta bucket ladder: an empty delta still scans one fully
+# masked bucket of this size, so "delta empty" and "delta tiny" share a
+# program instead of forking the hot path
+DELTA_MIN_BUCKET = 8
+
+
+class DeltaFullError(OverloadedError):
+    """The delta memtable is at capacity — writes shed load exactly like the
+    serve queue bound (same admission-control taxonomy: this IS an
+    ``OverloadedError``). Compact, or attach a
+    :class:`raft_tpu.stream.Compactor` whose delta-fill watermark folds the
+    memtable before it fills."""
+
+
+def delta_buckets(capacity: int) -> tuple[int, ...]:
+    """The delta memtable's power-of-two device-shape ladder
+    ``(8, 16, ..., capacity)``."""
+    expects(capacity >= DELTA_MIN_BUCKET
+            and (capacity & (capacity - 1)) == 0,
+            "delta_capacity must be a power of two >= %d, got %d",
+            DELTA_MIN_BUCKET, capacity)
+    out, b = [], DELTA_MIN_BUCKET
+    while b <= capacity:
+        out.append(b)
+        b *= 2
+    return tuple(out)
+
+
+def _bucket_for(n: int, capacity: int) -> int:
+    b = DELTA_MIN_BUCKET
+    while b < n:
+        b *= 2
+    return min(b, capacity)
+
+
+# -- metrics (catalogue: docs/observability.md) ------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _g_delta_fill():
+    return metrics.gauge("raft_tpu_stream_delta_fill",
+                         "delta memtable fill fraction (rows / capacity)")
+
+
+@functools.lru_cache(maxsize=None)
+def _g_delta_rows():
+    return metrics.gauge("raft_tpu_stream_delta_rows",
+                         "rows currently in the delta memtable")
+
+
+@functools.lru_cache(maxsize=None)
+def _g_tombstone():
+    return metrics.gauge(
+        "raft_tpu_stream_tombstone_ratio",
+        "dead sealed slots / sealed slots (reclaimable by rebuild compaction)")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_upserts():
+    return metrics.counter("raft_tpu_stream_upserts_total",
+                           "rows upserted into the delta memtable")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_deletes():
+    return metrics.counter("raft_tpu_stream_deletes_total",
+                           "live rows tombstoned by delete/upsert")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_delta_full():
+    return metrics.counter("raft_tpu_stream_delta_full_total",
+                           "writes refused because the delta memtable is full")
+
+
+# -- per-kind dispatch -------------------------------------------------------
+
+def _resolve_kind(sealed):
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    for kind, mod, cls in (("brute_force", brute_force, brute_force.BruteForce),
+                           ("ivf_flat", ivf_flat, ivf_flat.IvfFlatIndex),
+                           ("ivf_pq", ivf_pq, ivf_pq.IvfPqIndex),
+                           ("cagra", cagra, cagra.CagraIndex)):
+        if isinstance(sealed, cls):
+            return kind, mod
+    raise RaftError(
+        f"MutableIndex cannot wrap {type(sealed).__name__!r} (expected "
+        "BruteForce, IvfFlatIndex, IvfPqIndex or CagraIndex)")
+
+
+def _sealed_meta(kind, sealed):
+    """(n_rows, dim, metric, metric_arg, data_kind) of a sealed index."""
+    if kind == "brute_force":
+        expects(sealed.dataset is not None, "sealed brute_force index is not built")
+        n, d = sealed.dataset.shape
+        dk = str(sealed.dataset.dtype)
+        if dk not in ("int8", "uint8"):
+            dk = "float32"
+        return n, d, resolve_metric(sealed.metric), float(sealed.metric_arg), dk
+    return (sealed.size, sealed.dim, sealed.metric, 2.0, sealed.data_kind)
+
+
+def _recover_store(kind, sealed, data_kind):
+    """Raw live rows in the SERVING dtype, when the sealed kind stores them
+    (brute-force/CAGRA keep the dataset; uint8 CAGRA holds it shifted into
+    the s8 domain and is unshifted here). IVF kinds store lists/codes, not
+    rows — their store must be supplied via ``dataset=``."""
+    import jax
+
+    if kind == "brute_force":
+        return np.asarray(jax.device_get(sealed.dataset))
+    if kind == "cagra":
+        ds = np.asarray(jax.device_get(sealed.dataset))
+        if data_kind == "uint8":
+            return (ds.astype(np.int16) + 128).astype(np.uint8)
+        return ds
+    return None
+
+
+def _sealed_search(cfg, sealed, queries, k, keep_mask, res=None):
+    from ..neighbors import brute_force
+
+    if cfg.kind == "brute_force":
+        return brute_force.knn(sealed.dataset, queries, k, cfg.metric,
+                               cfg.metric_arg, sample_filter=keep_mask,
+                               res=res)
+    return cfg.module.search(cfg.search_params, sealed, queries, k,
+                             sample_filter=keep_mask, res=res)
+
+
+# -- jitted merge pieces -----------------------------------------------------
+
+@functools.cache
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@functools.cache
+def _jits():
+    import jax
+    import jax.numpy as jnp
+
+    from ..matrix.select_k import _select_k
+
+    @jax.jit
+    def map_ids(ids, id_map):
+        g = jnp.take(id_map, jnp.clip(ids, 0), axis=0)
+        return jnp.where(ids >= 0, g, -1)
+
+    @functools.partial(jax.jit, static_argnames=("k", "select_min"))
+    def merge(sealed_d, sealed_i, delta_d, delta_i, k: int, select_min: bool):
+        d = jnp.concatenate([sealed_d, delta_d], axis=1)
+        i = jnp.concatenate([sealed_i, delta_i], axis=1)
+        dv, iv = _select_k(d, i, k, select_min)
+        # underfilled slots keep the shared sentinel: id -1 at ±inf
+        return dv, jnp.where(jnp.isinf(dv), -1, iv)
+
+    return map_ids, merge
+
+
+def _map_ids(ids, id_map):
+    """Translate slot-local ids to global ids; -1 sentinels pass through."""
+    return _jits()[0](ids, id_map)
+
+
+def _merge(sealed_d, sealed_i, delta_d, delta_i, k, select_min):
+    return _jits()[1](sealed_d, sealed_i, delta_d, delta_i, int(k),
+                      bool(select_min))
+
+
+# -- state ------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Config:
+    """Immutable wrap-time configuration shared by every state epoch."""
+
+    kind: str
+    module: object
+    search_params: object
+    metric: DistanceType
+    metric_arg: float
+    select_min: bool
+    dim: int
+    data_kind: str
+    query_dtype: str
+    name: str
+
+
+class _StreamState:
+    """One epoch of mutable-index state. The big arrays (sealed index,
+    id map) are frozen per epoch — compaction builds a successor and swaps —
+    while the tombstone/delta device handles are REPLACED (never mutated in
+    place) on every write, so a search that snapshots the handles is always
+    internally consistent without holding the write lock."""
+
+    __slots__ = ("cfg", "sealed", "id_map", "sealed_alive", "store",
+                 "delta", "delta_ids", "delta_alive", "delta_n",
+                 "delta_oldest_at", "epoch", "id_map_dev", "sealed_keep_dev",
+                 "delta_view")
+
+    def __init__(self, cfg: _Config):
+        self.cfg = cfg
+        self.delta_n = 0
+        self.delta_oldest_at = None
+        self.epoch = 0
+
+
+def _np_dtype(query_dtype: str):
+    return {"float32": np.float32, "int8": np.int8,
+            "uint8": np.uint8}[query_dtype]
+
+
+def _refresh_sealed_keep(st: _StreamState) -> None:
+    jnp = _jnp()
+    st.sealed_keep_dev = jnp.asarray(st.sealed_alive)
+
+
+def _refresh_delta(st: _StreamState, capacity: int,
+                   mask_only: bool = False) -> None:
+    jnp = _jnp()
+    b = _bucket_for(st.delta_n, capacity)
+    keep = st.delta_alive[:b] & (np.arange(b) < st.delta_n)
+    # ONE attribute assignment: a lock-free reader snapshots rows, mask and
+    # ids that always belong to the same bucket shape (per-field replacement
+    # would let a grown rows array pair with a stale shorter mask).
+    # Transfer economy: deletes (mask_only — rows/ids untouched, bucket
+    # unchanged) reuse the published device arrays and re-upload just the
+    # bool mask. Upserts re-upload the whole bucket: a device-side splice
+    # of only the appended rows (lax.dynamic_update_slice) was considered
+    # and REJECTED — its program is keyed on the caller's write batch size,
+    # which warm() cannot enumerate, so it would put data-dependent
+    # compiles on the write path and void the warmed-ladder zero-compile
+    # guarantee the bucket discipline exists for. The memtable is small by
+    # design (<= capacity rows), so the O(bucket) host upload is bounded,
+    # value-independent, and compile-free.
+    view = getattr(st, "delta_view", None)
+    if mask_only and view is not None and view[3] == b:
+        rows_dev, ids_dev = view[0], view[2]
+    else:
+        rows_dev = jnp.asarray(st.delta[:b])
+        ids_dev = jnp.asarray(st.delta_ids[:b])
+    st.delta_view = (rows_dev, jnp.asarray(keep), ids_dev, b)
+
+
+def _build_loc(st: _StreamState) -> dict:
+    """id → live-slot map, built from vectorized numpy passes (zip over
+    materialized lists — ~10x a per-row Python loop with int() casts; at the
+    bench's 100k scale this runs in single-digit ms, which matters because
+    the compaction swap rebuilds it under the write lock)."""
+    s_slots = np.nonzero(st.sealed_alive)[0]
+    loc = dict(zip(st.id_map[s_slots].tolist(),
+                   zip(("s",) * len(s_slots), s_slots.tolist())))
+    d_slots = np.nonzero(st.delta_alive[:st.delta_n])[0]
+    loc.update(zip(st.delta_ids[d_slots].tolist(),
+                   zip(("d",) * len(d_slots), d_slots.tolist())))
+    return loc
+
+
+def _search_state(st: _StreamState, queries, k: int, res=None):
+    """Unified search over one state epoch: sealed(filtered) + delta scan,
+    merged through select_k, ids mapped to the global space. All device
+    handles are snapshotted up front, so a concurrent write (which replaces
+    handles, never mutates them) cannot tear this call."""
+    from ..neighbors import brute_force
+
+    jnp = _jnp()
+    cfg = st.cfg
+    # handle snapshot — one consistent view (delta_view is assigned as one
+    # tuple, sealed/id_map are frozen per epoch, sealed_keep only changes
+    # VALUES within an epoch, never shape). ORDER MATTERS: the delta view
+    # is read BEFORE the sealed keep-mask, pairing with upsert's
+    # kill-then-reveal publish order (sealed mask first, delta second) — a
+    # reader that sees an upserted id's new delta copy is then guaranteed
+    # to also see the old sealed copy's tombstone; the reverse read order
+    # could surface BOTH copies of one id in a single result row. (The
+    # benign anomaly — an id briefly absent — is the one the design
+    # accepts, like any read racing a write.)
+    delta, dkeep, dids, _ = st.delta_view
+    sealed, skeep, imap = st.sealed, st.sealed_keep_dev, st.id_map_dev
+
+    queries = jnp.asarray(queries)
+    expects(queries.ndim == 2 and queries.shape[1] == cfg.dim,
+            "queries must be (rows, %d)", cfg.dim)
+    if cfg.query_dtype == "float32":
+        queries = queries.astype(jnp.float32)
+    k = int(k)
+    sd, si = _sealed_search(cfg, sealed, queries, k, skeep, res=res)
+    si = _map_ids(si, imap)
+    kd = min(k, delta.shape[0])
+    dd, di = brute_force.knn(delta, queries, kd, cfg.metric, cfg.metric_arg,
+                             sample_filter=dkeep, res=res)
+    di = _map_ids(di, dids)
+    return _merge(sd, si, dd, di, k, cfg.select_min)
+
+
+# -- the mutable index -------------------------------------------------------
+
+class MutableIndex:
+    """Mutable lifecycle wrapper over a sealed index (see module docstring).
+
+    ``sealed`` must be a freshly built (or loaded) index whose stored ids
+    are the dense row range ``0..n-1`` — exactly what ``build()`` produces.
+    ``search_params`` are baked in at wrap time (the serving-hook
+    discipline); ``index_params`` are required only for rebuild compaction
+    of IVF kinds. ``delta_capacity`` (power of two) bounds the memtable;
+    ``retain_vectors`` keeps a host-side raw row store (required for
+    rebuild compaction — auto-recovered from brute-force/CAGRA sealed
+    datasets, supplied via ``dataset=`` for IVF kinds, whose codes cannot
+    reconstruct rows). ``clock`` is injected for deterministic tests (the
+    age watermark's time base).
+    """
+
+    def __init__(self, sealed, *, search_params=None, index_params=None,
+                 delta_capacity: int = 1024, retain_vectors: bool | None = None,
+                 dataset=None, name: str = "default",
+                 clock: Callable[[], float] = time.monotonic):
+        kind, module = _resolve_kind(sealed)
+        n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
+        expects(n > 0, "cannot wrap an empty sealed index")
+        if kind in ("ivf_flat", "ivf_pq"):
+            # the id-map contract: internal ids are the dense row range
+            import jax.numpy as jnp
+
+            expects(int(jnp.max(sealed.list_ids)) == n - 1,
+                    "sealed %s ids must be the dense row range 0..n-1 "
+                    "(a fresh build); wrap before extending with custom ids",
+                    kind)
+        query_dtype = data_kind if data_kind in ("int8", "uint8") else "float32"
+        if search_params is None and kind != "brute_force":
+            # default params at WRAP time, not an AttributeError at first
+            # search (which could land on a serving thread)
+            search_params = module.SearchParams()
+        cfg = _Config(kind=kind, module=module, search_params=search_params,
+                      metric=metric, metric_arg=metric_arg,
+                      select_min=metric != DistanceType.InnerProduct,
+                      dim=d, data_kind=data_kind, query_dtype=query_dtype,
+                      name=name)
+        self._cfg = cfg
+        self._index_params = index_params
+        self.delta_capacity = int(delta_capacity)
+        self._buckets = delta_buckets(self.delta_capacity)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._compact_lock = threading.Lock()
+        self._next_id = n
+        self._loc: dict[int, tuple[str, int]] = {}
+
+        store = None
+        if dataset is not None:
+            store = np.asarray(dataset)
+            expects(store.shape == (n, d),
+                    "dataset= must be the sealed rows (%d, %d), got %s",
+                    n, d, tuple(store.shape))
+            if query_dtype == "float32":
+                store = np.asarray(store, np.float32)
+            else:
+                expects(str(store.dtype) == query_dtype,
+                        "dataset= dtype %s must match the serving dtype %s",
+                        store.dtype, query_dtype)
+        elif retain_vectors is not False:
+            store = _recover_store(kind, sealed, data_kind)
+        if retain_vectors is True:
+            expects(store is not None,
+                    "retain_vectors=True needs dataset= for %s (stored codes "
+                    "cannot reconstruct raw rows)", kind)
+
+        st = _StreamState(cfg)
+        st.sealed = sealed
+        st.id_map = np.arange(n, dtype=np.int64)
+        st.sealed_alive = np.ones(n, bool)
+        st.store = store
+        dt = _np_dtype(query_dtype)
+        st.delta = np.zeros((self.delta_capacity, d), dt)
+        st.delta_ids = np.zeros(self.delta_capacity, np.int32)
+        st.delta_alive = np.zeros(self.delta_capacity, bool)
+        import jax.numpy as jnp
+
+        st.id_map_dev = jnp.asarray(st.id_map.astype(np.int32))
+        _refresh_sealed_keep(st)
+        _refresh_delta(st, self.delta_capacity)
+        self._state = st
+        self._loc = _build_loc(st)
+        self._update_gauges(st)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def kind(self) -> str:
+        return self._cfg.kind
+
+    @property
+    def dim(self) -> int:
+        return self._cfg.dim
+
+    @property
+    def name(self) -> str:
+        return self._cfg.name
+
+    @property
+    def query_dtype(self) -> str:
+        return self._cfg.query_dtype
+
+    @property
+    def can_rebuild(self) -> bool:
+        """Whether rebuild compaction (the tombstone-reclaiming mode) is
+        available: a raw row store, plus build params for IVF kinds."""
+        st = self._state
+        if st.store is None:
+            return False
+        return (self._cfg.kind in ("brute_force", "cagra")
+                or self._index_params is not None)
+
+    @property
+    def size(self) -> int:
+        """Live (searchable) rows."""
+        with self._lock:
+            st = self._state
+            return int(st.sealed_alive.sum()
+                       + st.delta_alive[:st.delta_n].sum())
+
+    def stats(self) -> dict:
+        with self._lock:
+            st = self._state
+            n_sealed = len(st.sealed_alive)
+            dead = int(n_sealed - st.sealed_alive.sum())
+            return {
+                "live": int(st.sealed_alive.sum()
+                            + st.delta_alive[:st.delta_n].sum()),
+                "sealed_rows": n_sealed,
+                "sealed_dead": dead,
+                "tombstone_ratio": dead / max(n_sealed, 1),
+                "delta_rows": int(st.delta_n),
+                "delta_fill": st.delta_n / self.delta_capacity,
+                "delta_bucket": st.delta_view[3],
+                "delta_oldest_at": st.delta_oldest_at,
+                "epoch": st.epoch,
+            }
+
+    def _update_gauges(self, st: _StreamState) -> None:
+        if not metrics._enabled:
+            return
+        name = self._cfg.name
+        n_sealed = len(st.sealed_alive)
+        dead = int(n_sealed - st.sealed_alive.sum())
+        _g_delta_fill().set(st.delta_n / self.delta_capacity, name=name)
+        _g_delta_rows().set(st.delta_n, name=name)
+        _g_tombstone().set(dead / max(n_sealed, 1), name=name)
+
+    # -- writes -------------------------------------------------------------
+    def _coerce_rows(self, rows):
+        rows = np.asarray(rows)
+        expects(rows.ndim == 2 and rows.shape[1] == self._cfg.dim,
+                "rows must be (r, %d)", self._cfg.dim)
+        if self._cfg.query_dtype == "float32":
+            return np.asarray(rows, np.float32)
+        expects(str(rows.dtype) == self._cfg.query_dtype,
+                "byte index %r takes %s rows, got %s", self._cfg.name,
+                self._cfg.query_dtype, rows.dtype)
+        return rows
+
+    def upsert(self, rows, ids=None):
+        """Insert rows (fresh ids assigned and returned) or upsert under
+        caller-chosen ids: the previous live occurrence of each id is
+        tombstoned and the new row becomes visible to the very next search
+        (read-your-writes — no compaction needed). Raises
+        :class:`DeltaFullError` (an ``OverloadedError``) at capacity."""
+        rows = self._coerce_rows(rows)
+        r = rows.shape[0]
+        expects(r >= 1, "upsert needs at least one row")
+        with self._lock:
+            st = self._state
+            if st.delta_n + r > self.delta_capacity:
+                if metrics._enabled:
+                    _c_delta_full().inc(1, name=self._cfg.name)
+                raise DeltaFullError(
+                    f"delta memtable at {st.delta_n}/{self.delta_capacity} "
+                    f"rows; upsert of {r} refused — compact() (or attach a "
+                    "stream.Compactor) to fold the delta into the sealed "
+                    "index")
+            if ids is None:
+                gids = np.arange(self._next_id, self._next_id + r,
+                                 dtype=np.int64)
+            else:
+                gids = np.asarray(ids, np.int64).reshape(-1)
+                expects(gids.shape == (r,), "ids must match rows (%d)", r)
+                expects(np.unique(gids).size == r,
+                        "upsert ids must be unique within one call")
+                expects(int(gids.min()) >= 0, "ids must be >= 0")
+            expects(int(gids.max()) < 2 ** 31 - 1,
+                    "ids must fit int32 (device id maps are int32)")
+            self._next_id = max(self._next_id, int(gids.max()) + 1)
+            sealed_dirty = self._tombstone_locked(st, gids.tolist())
+            p = st.delta_n
+            st.delta[p:p + r] = rows
+            st.delta_ids[p:p + r] = gids.astype(np.int32)
+            st.delta_alive[p:p + r] = True
+            for j, g in enumerate(gids.tolist()):
+                self._loc[g] = ("d", p + j)
+            if st.delta_n == 0:
+                st.delta_oldest_at = self._clock()
+            st.delta_n += r
+            # tombstone-before-reveal: the old copy's mask lands first so a
+            # lock-free reader can never see both copies of an upserted id
+            if sealed_dirty:
+                _refresh_sealed_keep(st)
+            _refresh_delta(st, self.delta_capacity)
+            if metrics._enabled:
+                _c_upserts().inc(r, name=self._cfg.name)
+            self._update_gauges(st)
+        return gids
+
+    def _tombstone_locked(self, st, gids) -> bool:
+        """Mark the live occurrence of each id dead; returns whether a
+        SEALED slot changed (the caller refreshes that device mask)."""
+        sealed_dirty = False
+        killed = 0
+        for g in gids:
+            loc = self._loc.pop(int(g), None)
+            if loc is None:
+                continue
+            killed += 1
+            if loc[0] == "s":
+                st.sealed_alive[loc[1]] = False
+                sealed_dirty = True
+            else:
+                st.delta_alive[loc[1]] = False
+        if killed and metrics._enabled:
+            _c_deletes().inc(killed, name=self._cfg.name)
+        return sealed_dirty
+
+    def delete(self, ids) -> int:
+        """Tombstone ids; returns how many were live. Deletes are visible to
+        the very next search (the masks flip before this returns); unknown
+        or already-dead ids are a counted no-op, not an error."""
+        arr = np.asarray(ids).reshape(-1)
+        with self._lock:
+            st = self._state
+            before = len(self._loc)
+            sealed_dirty = self._tombstone_locked(st, arr.tolist())
+            n = before - len(self._loc)
+            if sealed_dirty:
+                _refresh_sealed_keep(st)
+            # delta tombstones ride the keep mask; rows/ids are untouched
+            # by a delete, so only the mask re-uploads
+            _refresh_delta(st, self.delta_capacity, mask_only=True)
+            self._update_gauges(st)
+        return n
+
+    # -- reads --------------------------------------------------------------
+    def search(self, queries, k: int, res=None):
+        """Unified search over (sealed − tombstones) + delta; returns
+        ``(distances (m, k), global ids (m, k))`` with the shared
+        ``id -1 / ±inf`` sentinel in slots the live rows cannot fill."""
+        return _search_state(self._state, queries, k, res=res)
+
+    def searcher(self):
+        """Serving hook pinned to the CURRENT state epoch (the
+        ``batched_searcher`` contract: ``fn(queries, k)`` with
+        ``kind``/``dim``/``query_dtype``). Deletes/upserts remain visible
+        through a pinned hook until a compaction swap freezes its epoch —
+        from then on it serves the pre-compaction view, which is exactly
+        the lease-drain semantics ``serve.IndexRegistry`` wants."""
+        from ..neighbors._hooks import make_hook
+
+        st = self._state
+        fn = make_hook(lambda queries, k: _search_state(st, queries, k),
+                       f"stream/{st.cfg.kind}", st.cfg.dim,
+                       st.cfg.data_kind)
+        # marker for the serve write path: lets SearchService.publish tell a
+        # mutable's own hook (keep/retarget the upsert handle) from any
+        # other bare hook (close the write path)
+        fn.mutable = self
+        return fn
+
+    # -- warmup -------------------------------------------------------------
+    def warm(self, buckets, ks=(10,), sample=None) -> dict:
+        """Compile the delta-ladder program set: the exact delta scan at
+        EVERY memtable bucket × every serving (query-bucket, k), plus the
+        id-map and merge programs. These shapes are sealed-independent, so
+        one warm covers every future compaction epoch; the sealed-side
+        programs are warmed per epoch by ``registry.publish`` (which runs
+        the full hook). Returns per-(k, bucket) compile attribution like
+        :func:`raft_tpu._warmup.warm_buckets`."""
+        import jax
+
+        from .._warmup import _random_queries
+        from ..obs import compile as obs_compile
+
+        jnp = _jnp()
+        cfg = self._cfg
+        out: dict = {}
+        key = jax.random.key(0)
+        dt = _np_dtype(cfg.query_dtype)
+        from ..neighbors import brute_force
+
+        for kk in sorted(set(int(x) for x in ks)):
+            out[kk] = {}
+            for b in sorted(set(int(x) for x in buckets)):
+                key, kq = jax.random.split(key)
+                q = _random_queries(kq, b, cfg.dim, cfg.query_dtype,
+                                    sample=sample)
+                t0 = time.perf_counter()
+                with obs_compile.attribution() as rec:
+                    for db in self._buckets:
+                        dummy = jnp.zeros((db, cfg.dim), dt)
+                        keep = jnp.zeros((db,), bool)
+                        kd = min(kk, db)
+                        dd, di = brute_force.knn(
+                            dummy, q, kd, cfg.metric, cfg.metric_arg,
+                            sample_filter=keep)
+                        di = _map_ids(di, jnp.zeros((db,), jnp.int32))
+                        sd = jnp.zeros((b, kk), jnp.float32)
+                        si = jnp.full((b, kk), -1, jnp.int32)
+                        jax.block_until_ready(
+                            _merge(sd, si, dd, di, kk, cfg.select_min))
+                out[kk][b] = {"wall_s": round(time.perf_counter() - t0, 3),
+                              **rec.summary()}
+        return out
+
+    # -- compaction ---------------------------------------------------------
+    def compact(self, mode: str = "auto", res=None) -> dict:
+        """Fold the delta memtable (and, in rebuild mode, the tombstones)
+        into a new sealed index and swap it in atomically.
+
+        ``mode``: "extend" appends the live delta rows to the sealed lists
+        (IVF kinds only; tombstoned sealed slots stay masked), "rebuild"
+        rebuilds the sealed index from the raw live rows (drops tombstones
+        entirely; needs the retained row store), "auto" picks extend for
+        IVF kinds and rebuild otherwise. The heavy fold runs OFF the write
+        lock — searches keep serving the old state, and writes landing
+        mid-fold carry over: the fold consumes a snapshot prefix of the
+        delta, and every alive bit is re-read from the live tombstone state
+        at swap time. Returns a report dict (mode, rows folded/reclaimed,
+        wall seconds).
+        """
+        expects(mode in ("auto", "extend", "rebuild"),
+                "mode must be 'auto', 'extend' or 'rebuild', got %r", mode)
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self._cfg
+        with self._compact_lock:
+            if mode == "auto":
+                mode = ("extend" if cfg.kind in ("ivf_flat", "ivf_pq")
+                        else "rebuild")
+            expects(mode == "rebuild" or cfg.kind in ("ivf_flat", "ivf_pq"),
+                    "%s has no extend(); use mode='rebuild'", cfg.kind)
+            t0 = time.perf_counter()
+            with self._lock:
+                st = self._state
+                snap_n = st.delta_n
+                d_src = np.nonzero(st.delta_alive[:snap_n])[0]
+                fold_rows = st.delta[d_src].copy()
+                fold_gids = st.delta_ids[d_src].astype(np.int64)
+                if mode == "rebuild":
+                    expects(st.store is not None,
+                            "rebuild compaction needs the retained row store "
+                            "(retain_vectors=True / dataset=)")
+                    s_src = np.nonzero(st.sealed_alive)[0]
+
+            # ---- heavy fold, off the hot path ----------------------------
+            if mode == "extend":
+                n_old = len(st.id_map)
+                if len(d_src):
+                    new_sealed = cfg.module.extend(
+                        st.sealed, fold_rows,
+                        new_ids=jnp.arange(n_old, n_old + len(d_src),
+                                           dtype=jnp.int32),
+                        res=res)
+                else:
+                    new_sealed = st.sealed
+                new_id_map = np.concatenate([st.id_map, fold_gids])
+                new_store = (np.concatenate([st.store, fold_rows])
+                             if st.store is not None else None)
+                reclaimed = 0
+            else:
+                live_rows = np.concatenate([st.store[s_src], fold_rows])
+                expects(live_rows.shape[0] > 0,
+                        "compaction would leave an empty index")
+                new_id_map = np.concatenate([st.id_map[s_src], fold_gids])
+                new_store = live_rows
+                reclaimed = len(st.id_map) - len(s_src)
+                x = jnp.asarray(live_rows)
+                if cfg.kind == "brute_force":
+                    from ..neighbors import brute_force
+
+                    new_sealed = brute_force.BruteForce(
+                        cfg.metric, cfg.metric_arg).build(x)
+                else:
+                    ip = self._index_params
+                    if cfg.kind == "cagra" and ip is None:
+                        ip = cfg.module.IndexParams()
+                    expects(ip is not None,
+                            "rebuild compaction of %s needs index_params "
+                            "(build configuration)", cfg.kind)
+                    new_sealed = cfg.module.build(ip, x, res=res)
+            # materialize before the swap (BruteForce is not a pytree —
+            # block on its dataset directly)
+            if cfg.kind == "brute_force":
+                jax.block_until_ready(new_sealed.dataset)
+            else:
+                jax.block_until_ready(jax.tree_util.tree_leaves(new_sealed))
+            id_map_dev = jnp.asarray(new_id_map.astype(np.int32))
+
+            # ---- atomic swap ---------------------------------------------
+            with self._lock:
+                st = self._state
+                nd = _StreamState(cfg)
+                nd.sealed = new_sealed
+                nd.id_map = new_id_map
+                nd.store = new_store
+                # alive bits re-read from the LIVE state: deletes that
+                # landed mid-fold are preserved across the swap
+                if mode == "extend":
+                    nd.sealed_alive = np.concatenate(
+                        [st.sealed_alive, st.delta_alive[d_src]])
+                else:
+                    nd.sealed_alive = np.concatenate(
+                        [st.sealed_alive[s_src], st.delta_alive[d_src]])
+                dt = _np_dtype(cfg.query_dtype)
+                nd.delta = np.zeros((self.delta_capacity, cfg.dim), dt)
+                nd.delta_ids = np.zeros(self.delta_capacity, np.int32)
+                nd.delta_alive = np.zeros(self.delta_capacity, bool)
+                rem = st.delta_n - snap_n
+                if rem:
+                    nd.delta[:rem] = st.delta[snap_n:st.delta_n]
+                    nd.delta_ids[:rem] = st.delta_ids[snap_n:st.delta_n]
+                    nd.delta_alive[:rem] = st.delta_alive[snap_n:st.delta_n]
+                nd.delta_n = rem
+                nd.delta_oldest_at = self._clock() if rem else None
+                nd.epoch = st.epoch + 1
+                nd.id_map_dev = id_map_dev
+                _refresh_sealed_keep(nd)
+                _refresh_delta(nd, self.delta_capacity)
+                # location map: every live id points at its new slot
+                self._loc = _build_loc(nd)
+                self._state = nd
+                self._update_gauges(nd)
+            return {"mode": mode, "epoch": nd.epoch,
+                    "folded": int(len(d_src)), "reclaimed": int(reclaimed),
+                    "sealed_rows": int(len(nd.id_map)),
+                    "delta_remaining": int(rem),
+                    "wall_s": round(time.perf_counter() - t0, 3)}
+
+
+# -- serialization (raft_tpu/8 "stream" section) -----------------------------
+
+def save(mutable: MutableIndex, path: str) -> None:
+    """Serialize the FULL mutable state — sealed index, delta memtable,
+    tombstone bitsets, id map — as one ``stream`` section (raft_tpu/8).
+    The sealed index rides embedded through its own module serializer
+    (``write_index``), so its layout/back-compat rules are unchanged."""
+    from ..core.serialize import (serialize_header, serialize_mdspan,
+                                  serialize_scalar)
+
+    with mutable._lock:
+        st = mutable._state
+        cfg = mutable._cfg
+        with open(path, "wb") as f:
+            serialize_header(f, "stream")
+            serialize_scalar(f, cfg.kind)
+            serialize_scalar(f, cfg.name)
+            serialize_scalar(f, mutable.delta_capacity)
+            serialize_scalar(f, int(mutable._next_id))
+            serialize_scalar(f, int(st.delta_n))
+            serialize_scalar(f, st.store is not None)
+            serialize_mdspan(f, st.id_map)
+            serialize_mdspan(f, st.sealed_alive)
+            serialize_mdspan(f, st.delta[:st.delta_n])
+            serialize_mdspan(f, st.delta_ids[:st.delta_n])
+            serialize_mdspan(f, st.delta_alive[:st.delta_n])
+            if st.store is not None:
+                serialize_mdspan(f, st.store)
+            cfg.module.write_index(f, st.sealed)
+
+
+def load(path: str, *, search_params=None, index_params=None,
+         name: str | None = None,
+         clock: Callable[[], float] = time.monotonic) -> MutableIndex:
+    """Load a :func:`save`d mutable index. ``search_params``/
+    ``index_params`` are runtime configuration (like every other index
+    loader) and are supplied fresh here."""
+    from ..core.serialize import (check_header, deserialize_mdspan,
+                                  deserialize_scalar)
+    from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
+
+    mods = {"brute_force": brute_force, "ivf_flat": ivf_flat,
+            "ivf_pq": ivf_pq, "cagra": cagra}
+    with open(path, "rb") as f:
+        check_header(f, "stream")
+        kind = deserialize_scalar(f)
+        saved_name = deserialize_scalar(f)
+        capacity = int(deserialize_scalar(f))
+        next_id = int(deserialize_scalar(f))
+        delta_n = int(deserialize_scalar(f))
+        has_store = bool(deserialize_scalar(f))
+        id_map = np.asarray(deserialize_mdspan(f))
+        sealed_alive = np.asarray(deserialize_mdspan(f)).astype(bool)
+        delta = np.asarray(deserialize_mdspan(f))
+        delta_ids = np.asarray(deserialize_mdspan(f))
+        delta_alive = np.asarray(deserialize_mdspan(f)).astype(bool)
+        store = np.asarray(deserialize_mdspan(f)) if has_store else None
+        sealed = mods[kind].read_index(f)
+
+    m = MutableIndex(sealed, search_params=search_params,
+                     index_params=index_params, delta_capacity=capacity,
+                     retain_vectors=has_store, dataset=store,
+                     name=saved_name if name is None else name, clock=clock)
+    with m._lock:
+        st = m._state
+        st.id_map = id_map.astype(np.int64)
+        st.sealed_alive = sealed_alive
+        st.delta[:delta_n] = delta
+        st.delta_ids[:delta_n] = delta_ids
+        st.delta_alive[:delta_n] = delta_alive
+        st.delta_n = delta_n
+        # the restored delta's true write times are gone — age it from load
+        # time (conservative: the Compactor's max_age_s watermark stays
+        # armed for a restored non-empty delta instead of silently never
+        # firing)
+        st.delta_oldest_at = clock() if delta_n else None
+        m._next_id = next_id
+        import jax.numpy as jnp
+
+        st.id_map_dev = jnp.asarray(st.id_map.astype(np.int32))
+        _refresh_sealed_keep(st)
+        _refresh_delta(st, capacity)
+        m._loc = _build_loc(st)
+        m._update_gauges(st)
+    return m

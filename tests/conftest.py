@@ -56,6 +56,28 @@ def rng():
     return np.random.default_rng(42)
 
 
+@pytest.fixture
+def check_filter_underfill():
+    """Shared filtered-search underfill contract (ISSUE 5 satellite): when
+    fewer than k rows survive a sample filter, every neighbors module must
+    report the surviving rows first (finite scores, real ids) and fill the
+    rest with id -1 at +inf (L2) / -inf (inner product) — one checker so
+    the four modules cannot drift apart."""
+
+    def check(dists, ids, expected_alive, select_min=True):
+        d, i = np.asarray(dists), np.asarray(ids)
+        alive = sorted(expected_alive)
+        n_alive = len(alive)
+        bad = np.inf if select_min else -np.inf
+        assert (i[:, n_alive:] == -1).all(), i
+        assert (d[:, n_alive:] == bad).all(), d
+        assert np.isfinite(d[:, :n_alive]).all(), d
+        for row in i[:, :n_alive]:
+            assert sorted(row.tolist()) == alive, (row, alive)
+
+    return check
+
+
 def pytest_collection_modifyitems(config, items):
     """Apply the slow marker from tests/slow_tests.txt (measured durations on
     the CPU mesh — see pytest.ini). The fast tier is `pytest -m "not slow"`."""

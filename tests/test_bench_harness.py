@@ -242,3 +242,58 @@ def test_render_note_quotes_the_artifact():
     # regression guard: the r05 drift was prose saying 162.8k/148.3k/1.098
     for stale in ("162", "148,3", "1.098"):
         assert stale not in note
+
+
+def test_serve_churn_row_smoke():
+    """The --serve-churn bench row (ISSUE 5 acceptance measurement) must
+    produce a full row: search qps + latency percentiles, write throughput,
+    >= 2 compaction swaps with zero failed queries, mid-churn recall
+    bookkeeping, and the rehearsal-backed zero-cold-compile proof. Shrunk
+    shapes (toy PQ quantization — the recall PARITY bar applies at driver
+    scale; here the gap bound is loose), real protocol on the TPU driver."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_serve_churn(rows, n=2500, d=32, n_lists=16, pq_dim=32, k=5,
+                           n_probes=32, threads=3, writer_steps=12,
+                           upserts_per_step=24, deletes_per_step=8,
+                           delta_capacity=128, compact_fill=0.75,
+                           max_batch=8, max_wait_us=500.0, ncl=32, n_eval=64)
+    row = rows[-1]
+    assert row["name"] == "serve_churn_ivf_pq_100k" and "error" not in row, rows
+    assert row["churn"]["failed"] == 0, row
+    assert row["churn"]["compactions"] >= 2, row
+    # zero cold compiles across the whole loaded window — folds, publish
+    # warms, flips and every flush (the rehearsal pre-compiled the epochs)
+    assert row["churn"]["compile_s"] == 0.0, row
+    assert row["churn"]["cache_misses"] == 0, row
+    assert row["qps"] > 0 and row["write_rows_per_s"] > 0, row
+    assert row["p99_ms"] >= row["p50_ms"] > 0, row
+    # toy-scale PQ: parity only loosely; the 0.01 bar is the 100k row's
+    assert abs(row["recall_gap"]) < 0.25, row
+    assert row["recall_mut"] > 0.3, row
+
+
+def test_serve_churn_flag_runs_only_the_churn_row(monkeypatch):
+    """`bench.py --serve-churn` is the stream parameter-iteration loop:
+    setup + the churn row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_serve_churn",
+        lambda rows: rows.append({"name": "serve_churn_ivf_pq_100k",
+                                  "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--serve-churn"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "serve_churn_ivf_pq_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()

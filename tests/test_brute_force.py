@@ -175,3 +175,28 @@ def test_pairwise_compute_knob(rng):
     d_b = np.asarray(pairwise_distance(x, y, metric="cosine", compute="bfloat16"))
     d_e = np.asarray(pairwise_distance(x, y, metric="cosine", compute="float32"))
     np.testing.assert_allclose(d_b, d_e, atol=2e-2)
+
+
+class TestFilterUnderfill:
+    """Shared filtered-underfill contract (ISSUE 5 satellite) — the
+    documented -1/±inf sentinel, via the same checker every neighbors
+    module now pins."""
+
+    def test_underfill_sentinels(self, rng, check_filter_underfill):
+        x = rng.random((400, 16)).astype(np.float32)
+        q = rng.random((20, 16)).astype(np.float32)
+        alive = [7, 123, 399]
+        keep = np.zeros(400, bool)
+        keep[alive] = True
+        d, i = knn(x, q, k=6, sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    def test_underfill_sentinels_inner_product(self, rng,
+                                               check_filter_underfill):
+        x = rng.random((400, 16)).astype(np.float32)
+        q = rng.random((20, 16)).astype(np.float32)
+        alive = [0, 200]
+        keep = np.zeros(400, bool)
+        keep[alive] = True
+        d, i = knn(x, q, k=5, metric="inner_product", sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=False)

@@ -526,3 +526,70 @@ def test_build_select_impl_pallas_matches_xla():
             seed=0)
         graphs[impl] = np.asarray(cagra.build_knn_graph(params, x))
     np.testing.assert_array_equal(graphs["xla"], graphs["pallas"])
+
+
+class TestSampleFilter:
+    """`sample_filter=` parity with brute_force/ivf_pq (ISSUE 5 satellite):
+    mask epilogue on candidate scores before the beam select, same
+    resolve_filter/validate_filter_covers contract, shared -1/+inf
+    underfill sentinel."""
+
+    def test_filtered_matches_filtered_brute_force(self, index, data):
+        from raft_tpu.neighbors import brute_force
+
+        x, q = data
+        keep = np.ones(x.shape[0], bool)
+        keep[::2] = False  # drop half the rows
+        d, i = cagra.search(cagra.SearchParams(itopk_size=64), index, q, 10,
+                            sample_filter=keep)
+        i = np.asarray(i)
+        assert (i[i >= 0] % 2 == 1).all()  # only kept rows surface
+        _, ref = brute_force.knn(x, q, 10, sample_filter=keep)
+        assert _recall(i, np.asarray(ref)) > 0.9
+
+    def test_bitset_filter_object(self, index, data):
+        from raft_tpu.neighbors import BitsetFilter
+
+        x, q = data
+        keep = np.zeros(x.shape[0], bool)
+        keep[:100] = True
+        _, i = cagra.search(cagra.SearchParams(itopk_size=64), index, q, 10,
+                            sample_filter=BitsetFilter(keep))
+        i = np.asarray(i)
+        assert ((i < 100) | (i == -1)).all()
+
+    def test_underfill_sentinels(self, index, data, check_filter_underfill):
+        x, q = data
+        alive = [5, 77, 1234]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = cagra.search(cagra.SearchParams(itopk_size=64), index, q, 10,
+                            sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    def test_filter_cover_validated(self, index, data):
+        from raft_tpu.core.errors import RaftError
+
+        x, q = data
+        with pytest.raises(RaftError, match="cover"):
+            cagra.search(cagra.SearchParams(), index, q, 10,
+                         sample_filter=np.ones(x.shape[0] - 1, bool))
+
+    @pytest.mark.parametrize("impl", ["fused_arena"])
+    def test_fused_hop_filter_matches_xla(self, index, data, monkeypatch,
+                                          impl):
+        monkeypatch.setenv("RAFT_TPU_CAGRA_HOP_INTERPRET", "1")
+        x, q = data
+        keep = np.ones(x.shape[0], bool)
+        keep[:x.shape[0] // 2] = False
+        d_x, i_x = cagra.search(
+            cagra.SearchParams(itopk_size=32, hop_impl="xla"), index, q, 10,
+            sample_filter=keep)
+        d_f, i_f = cagra.search(
+            cagra.SearchParams(itopk_size=32, hop_impl=impl), index, q, 10,
+            sample_filter=keep)
+        i_x, i_f = np.asarray(i_x), np.asarray(i_f)
+        assert (i_f[i_f >= 0] >= x.shape[0] // 2).all()
+        overlap = np.mean([len(set(i_x[r]) & set(i_f[r])) / 10
+                           for r in range(i_x.shape[0])])
+        assert overlap > 0.95, overlap

@@ -183,18 +183,36 @@ class TestSerializationVersion:
             assert loaded.data_kind == "float32", old_ver  # from stored dtype
 
         pq = ivf_pq.build(ivf_pq.IndexParams(n_lists=8, pq_dim=8, seed=0), x)
-        p2 = str(tmp_path / "pqv2.bin")
-        ivf_pq.save(pq, p2)
-        raw2 = open(p2, "rb").read()
-        i0 = raw2.index(b"raft_tpu/5")
-        # /3 and /4 ivf_pq layouts == /5 layout: relabeled files must load
-        for old_ver in (b"raft_tpu/3", b"raft_tpu/4"):
-            open(p2, "wb").write(raw2[:i0] + old_ver + raw2[i0 + 10:])
-            assert ivf_pq.load(p2).pq_bits == pq.pq_bits
+        # hand-write the true /3-era ivf_pq layout (the splice-a-current-file
+        # approach rotted at the /6 bump: the current writer emits data_kind
+        # + list_scales, which an old header tells the reader to skip):
+        # header, metric, codebook_kind, pq_bits, split_factor, pq_split,
+        # then exactly 8 mdspans — no data_kind scalar, no list_scales.
+        for old_ver in ("raft_tpu/3", "raft_tpu/4", "raft_tpu/5"):
+            p2 = str(tmp_path / f"pq_{old_ver.replace('/', '_')}.bin")
+            with open(p2, "wb") as f:
+                serialize_scalar(f, "ivf_pq")
+                serialize_scalar(f, old_ver)
+                serialize_scalar(f, int(pq.metric))
+                serialize_scalar(f, pq.codebook_kind)
+                serialize_scalar(f, pq.pq_bits)
+                serialize_scalar(f, float(pq.split_factor))
+                serialize_scalar(f, bool(pq.pq_split))
+                for arr in (pq.centers, pq.centers_rot, pq.rotation,
+                            pq.codebooks, pq.list_codes, pq.list_ids,
+                            pq.list_sizes, pq.list_consts):
+                    serialize_mdspan(f, arr)
+            loaded = ivf_pq.load(p2)
+            assert loaded.pq_bits == pq.pq_bits
+            assert loaded.data_kind == "float32"  # pre-/6 files are float
+            assert loaded.list_scales.shape == (0,)  # pre-/7: norm disabled
         # /2 ivf_pq layout predates pq_split/list_consts: must fail clearly
-        open(p2, "wb").write(raw2[:i0] + b"raft_tpu/2" + raw2[i0 + 10:])
+        p3 = str(tmp_path / "pq_v2.bin")
+        with open(p3, "wb") as f:
+            serialize_scalar(f, "ivf_pq")
+            serialize_scalar(f, "raft_tpu/2")
         with pytest.raises(RaftError, match="unsupported ivf_pq index file format"):
-            ivf_pq.load(p2)
+            ivf_pq.load(p3)
 
 
 def test_output_conversion_skips_tracers(rng):

@@ -433,3 +433,65 @@ def test_search_inside_enclosing_jit(rng):
         lambda ix, qq: ivf_pq.search(ivf_pq.SearchParams(n_probes=4), ix, qq, 3))(pq, q)
     np.testing.assert_array_equal(np.asarray(p0[1]), np.asarray(p1[1]))
     np.testing.assert_array_equal(np.asarray(p0[1]), np.asarray(p2[1]))
+
+
+class TestSampleFilterEquivalence:
+    """Filtered search vs prefiltered rebuild (ISSUE 5 satellite): at
+    exhaustive probes, searching with `sample_filter=keep` must equal
+    building a fresh index over ONLY the kept rows — same neighbor ids
+    (mapped through the kept-row order), same distances. Holds for float
+    and byte storage, and pins the shared -1/+inf underfill contract."""
+
+    def test_filtered_equals_prefiltered_rebuild(self, data):
+        x, q = data
+        rng = np.random.default_rng(5)
+        keep = rng.random(x.shape[0]) > 0.4
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+        d_f, i_f = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=64), idx, q, 10, sample_filter=keep)
+        pre = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x[keep])
+        d_p, i_p = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=64), pre, q, 10)
+        kept_rows = np.nonzero(keep)[0]
+        i_p = kept_rows[np.asarray(i_p)]  # positions -> original row ids
+        np.testing.assert_array_equal(np.asarray(i_f), i_p)
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_p),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_filtered_equals_prefiltered_rebuild_bytes(self):
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, 256, (1500, 16), dtype=np.uint8)
+        q = rng.integers(0, 256, (20, 16), dtype=np.uint8)
+        keep = rng.random(1500) > 0.5
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x)
+        d_f, i_f = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=32), idx, q, 10, sample_filter=keep)
+        pre = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), x[keep])
+        d_p, i_p = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=32), pre, q, 10)
+        i_p = np.nonzero(keep)[0][np.asarray(i_p)]
+        np.testing.assert_array_equal(np.asarray(i_f), i_p)
+        np.testing.assert_allclose(np.asarray(d_f), np.asarray(d_p),
+                                   rtol=1e-5)
+
+    def test_underfill_sentinels(self, data, check_filter_underfill):
+        x, q = data
+        idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, seed=0), x)
+        alive = [9, 480, 3111]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=64), idx, q, 10, sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    def test_underfill_sentinels_inner_product(self, data,
+                                               check_filter_underfill):
+        x, q = data
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, metric="inner_product", seed=0), x)
+        alive = [12, 77]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=64), idx, q, 10, sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=False)

@@ -784,3 +784,32 @@ class TestResidualScaleNorm:
                 want = float(qrot[r] @ (crot[l] + sc[l] * dec))
                 np.testing.assert_allclose(d_got[r, c], want, rtol=2e-3,
                                            atol=2e-3)
+
+
+class TestFilterUnderfill:
+    """Shared filtered-underfill contract (ISSUE 5 satellite): when fewer
+    than k rows survive the filter, ids are -1 at +inf (L2) / -inf (IP) —
+    same checker as brute_force/ivf_flat/cagra."""
+
+    def test_underfill_sentinels(self, data, check_filter_underfill):
+        x, q = data
+        idx = ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0), x)
+        alive = [44, 1023, 5020]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=64), idx, q, 10,
+                             sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    def test_underfill_sentinels_inner_product(self, data,
+                                               check_filter_underfill):
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                               metric="inner_product", seed=0), x)
+        alive = [3, 997]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=64), idx, q, 10,
+                             sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=False)
