@@ -50,7 +50,15 @@ archives per round:
                                  (recall_gap), and zero cold compiles on
                                  the search hot path (churn.compile_s == 0,
                                  rehearsal-warmed). `--serve-churn` runs
-                                 ONLY this row.
+                                 ONLY the churn rows.
+  serve_churn_cagra_100k         the same churn protocol on a CAGRA-backed
+                                 MutableIndex: compactions run the REBUILD
+                                 path (no extend for graphs), so the row
+                                 measures build speed as serving capacity —
+                                 write_rows_per_s is bounded by the rebuild
+                                 wall (churn.compaction_wall_s); the r07
+                                 mini-batch coarse EM + sharded builds
+                                 surface here as write throughput.
   ivf_flat_1m_p8                 IVF-Flat on the isotropic clustered 1M set
   cagra_1m_itopk32               CAGRA on the same set
 
@@ -750,13 +758,76 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     thread, so fold sizes are schedule-deterministic and the rehearsal's
     shapes match); the background-thread mode is covered by
     tests/test_stream.py."""
+    from raft_tpu.neighbors import ivf_pq
+
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim,
+                                seed=0)
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+    _serve_churn_impl(
+        rows, name="serve_churn_ivf_pq_100k", note="churn",
+        build=lambda x: ivf_pq.build(params, x),
+        materialize=lambda idx: idx.list_codes,
+        search_params=sp,
+        oracle_search=lambda idx, q, kk: ivf_pq.search(sp, idx, q, kk),
+        mutable_kwargs=dict(retain_vectors=False),
+        n=n, d=d, k=k, threads=threads, writer_steps=writer_steps,
+        upserts_per_step=upserts_per_step, deletes_per_step=deletes_per_step,
+        delta_capacity=delta_capacity, compact_fill=compact_fill,
+        max_batch=max_batch, max_wait_us=max_wait_us, ncl=ncl, n_eval=n_eval)
+
+
+def _row_serve_churn_cagra(rows, n=100_000, d=128, k=10, itopk=32,
+                           threads=8, writer_steps=48, upserts_per_step=96,
+                           deletes_per_step=32, delta_capacity=4096,
+                           compact_fill=0.75, max_batch=64,
+                           max_wait_us=2000.0, ncl=2000, n_eval=512):
+    """CAGRA-backed MutableIndex churn row (ISSUE 6): same protocol and
+    acceptance claims as ``_row_serve_churn``, but compaction runs the
+    REBUILD path — CAGRA has no extend(), so every fold reconstructs the
+    sealed graph from the retained live rows (reclaiming tombstones). That
+    makes the row the direct measurement of the build-speed-as-a-serving
+    -feature claim: sustainable ``write_rows_per_s`` is bounded by the
+    rebuild wall (``churn.compaction_wall_s``), so coarse-EM and sharded
+    -build speedups surface here as measured write throughput. Rehearsal
+    still proves zero cold compiles: the deterministic schedule fixes every
+    epoch's sealed row count, so the rehearsal compiles the exact rebuild +
+    search program set the live window replays."""
+    from raft_tpu.neighbors import cagra
+
+    params = cagra.IndexParams(seed=0)
+    sp = cagra.SearchParams(itopk_size=itopk)
+    _serve_churn_impl(
+        rows, name="serve_churn_cagra_100k", note="churn-cagra",
+        build=lambda x: cagra.build(params, x),
+        materialize=lambda idx: idx.graph,
+        search_params=sp,
+        oracle_search=lambda idx, q, kk: cagra.search(sp, idx, q, kk),
+        # rebuild compaction: row store auto-recovered from the sealed
+        # dataset; index_params configure each rebuild
+        mutable_kwargs=dict(index_params=params),
+        n=n, d=d, k=k, threads=threads, writer_steps=writer_steps,
+        upserts_per_step=upserts_per_step, deletes_per_step=deletes_per_step,
+        delta_capacity=delta_capacity, compact_fill=compact_fill,
+        max_batch=max_batch, max_wait_us=max_wait_us, ncl=ncl, n_eval=n_eval)
+
+
+def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
+                      oracle_search, mutable_kwargs, n, d, k, threads,
+                      writer_steps, upserts_per_step, deletes_per_step,
+                      delta_capacity, compact_fill, max_batch, max_wait_us,
+                      ncl, n_eval):
+    """The shared churn protocol (see _row_serve_churn's docstring for the
+    claims): dataset + sealed build, rehearsal (compiles every compaction
+    epoch's program set), the attributed live window, then the fresh-oracle
+    recall snapshot. ``build``/``oracle_search`` close over the index
+    module's params so the IVF-PQ and CAGRA rows differ only in the sealed
+    kind and therefore in the fold mode (extend vs rebuild)."""
     import threading
 
     import jax
     import numpy as np
 
     from raft_tpu import stream
-    from raft_tpu.neighbors import ivf_pq
     from raft_tpu.neighbors.brute_force import knn
     from raft_tpu.obs import compile as obs_compile
     from raft_tpu.serve import IndexRegistry, SearchService
@@ -765,7 +836,7 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     total_deletes = writer_steps * deletes_per_step
     assert total_deletes < n, "delete schedule exceeds the dataset"
 
-    _note("churn: dataset")
+    _note(f"{note}: dataset")
     dataset, qsets = _make_clustered(n + total_upserts, d, max(n_eval, 1000),
                                      ncl, n_qsets=1, seed=13)
     jax.block_until_ready([dataset] + qsets)
@@ -774,14 +845,12 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     pool = np.asarray(qsets[0])
     eval_q = pool[:n_eval]
 
-    _note("churn: ivf_pq build")
+    _note(f"{note}: sealed build")
     t0 = time.perf_counter()
-    params = ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim,
-                                seed=0)
-    idx = ivf_pq.build(params, dataset[:n])
-    jax.block_until_ready(idx.list_codes)
+    idx = build(dataset[:n])
+    jax.block_until_ready(materialize(idx))
     build_s = time.perf_counter() - t0
-    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+    sp = search_params
 
     policy = stream.CompactionPolicy(delta_fill=compact_fill,
                                      tombstone_ratio=None, max_age_s=None)
@@ -803,11 +872,12 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
         return reports
 
     # ---- rehearsal: compile every compaction-epoch program off-line ------
-    _note("churn: rehearsal (compiles the epoch program set)")
+    _note(f"{note}: rehearsal (compiles the epoch program set)")
     from raft_tpu.serve import bucket_sizes
 
-    m0 = stream.MutableIndex(idx, search_params=sp, retain_vectors=False,
-                             delta_capacity=delta_capacity, name="rehearsal")
+    m0 = stream.MutableIndex(idx, search_params=sp,
+                             delta_capacity=delta_capacity, name="rehearsal",
+                             **mutable_kwargs)
     reg0 = IndexRegistry(buckets=bucket_sizes(max_batch))
     reg0.publish("churn-rehearsal", m0, k=k)
     m0.warm(reg0.buckets, ks=(k,))
@@ -817,9 +887,10 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     del m0, comp0, reg0
 
     # ---- the real, attributed window -------------------------------------
-    _note("churn: live window, %d reader threads" % threads)
-    m = stream.MutableIndex(idx, search_params=sp, retain_vectors=False,
-                            delta_capacity=delta_capacity, name="churn")
+    _note(f"{note}: live window, {threads} reader threads")
+    m = stream.MutableIndex(idx, search_params=sp,
+                            delta_capacity=delta_capacity, name=note,
+                            **mutable_kwargs)
     svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
                         max_queue_rows=max(4 * max_batch * threads, 256))
     svc.publish("churn", m, k=k)
@@ -879,7 +950,7 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     svc.shutdown()
 
     # ---- oracle: fresh build over the mid-churn live rows ----------------
-    _note("churn: fresh-oracle build over the mid-churn live set")
+    _note(f"{note}: fresh-oracle build over the mid-churn live set")
     del_done, ins_done = eval_box["del_done"], eval_box["ins_done"]
     live_mat = np.concatenate([x_host[del_done:], churn_host[:ins_done]])
     live_gids = np.concatenate([np.arange(del_done, n),
@@ -887,16 +958,16 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
     _, gt_pos = knn(live_mat, eval_q, k)
     gt_gids = live_gids[np.asarray(gt_pos)]
     recall_mut = _recall(eval_box["ids"], gt_gids)
-    oracle = ivf_pq.build(params, live_mat)
-    jax.block_until_ready(oracle.list_codes)
-    _, o_pos = ivf_pq.search(sp, oracle, eval_q, k)
+    oracle = build(live_mat)
+    jax.block_until_ready(materialize(oracle))
+    _, o_pos = oracle_search(oracle, eval_q, k)
     o_pos = np.asarray(o_pos)
     oracle_gids = np.where(o_pos >= 0, live_gids[np.clip(o_pos, 0, None)], -1)
     recall_oracle = _recall(oracle_gids, gt_gids)
 
     lats_ms = np.sort(np.array(lats if lats else [0.0])) * 1e3
     rows.append({
-        "name": "serve_churn_ivf_pq_100k",
+        "name": name,
         "qps": round(served[0] / load_s, 1),
         "p50_ms": round(float(lats_ms[len(lats_ms) // 2]), 3),
         "p99_ms": round(float(lats_ms[int(len(lats_ms) * 0.99) - 1]), 3),
@@ -1147,6 +1218,11 @@ def _run(rows):
                    lambda: _row_serve_churn(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "serve_churn_cagra_100k",
+                   lambda: _row_serve_churn_cagra(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -1215,11 +1291,14 @@ def main(argv=None):
         pass
     try:
         if "--serve-churn" in argv:
-            # mutable-lifecycle churn row only (ISSUE 5): the quick loop
-            # for iterating on stream/compactor parameters
+            # mutable-lifecycle churn rows only (ISSUE 5/6): the quick loop
+            # for iterating on stream/compactor parameters — IVF-PQ (extend
+            # folds) and CAGRA (rebuild folds, the build-speed payoff row)
             _setup(rows)
             _row_guard(rows, "serve_churn_ivf_pq_100k",
                        lambda: _row_serve_churn(rows))
+            _row_guard(rows, "serve_churn_cagra_100k",
+                       lambda: _row_serve_churn_cagra(rows))
         elif "--serve" in argv:
             # serving-layer A/B only (ISSUE 3): the quick loop for
             # iterating on batcher/registry parameters

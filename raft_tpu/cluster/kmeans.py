@@ -57,6 +57,15 @@ class KMeansParams:
     n_init: int = 1
     oversampling_factor: float = 2.0  # kept for param parity; ++ is exact here
     batch_samples: int = 1 << 15  # assignment tile rows (memory heuristic)
+    # EM iteration cost policy for the DISTRIBUTED driver
+    # (raft_tpu.parallel.kmeans.fit): "minibatch" iterates Lloyd over
+    # rotating per-shard mini-batches of ``batch_rows`` global rows with the
+    # streaming 1/c center update, closing with one full pass for labels +
+    # inertia; "auto" switches to minibatch above 2 x batch_rows (the
+    # kmeans_balanced.resolve_train_mode rule). The single-chip fit() always
+    # runs full Lloyd — tol-based convergence is its contract.
+    train_mode: str = "full"
+    batch_rows: int = 1 << 16
 
 
 @dataclasses.dataclass

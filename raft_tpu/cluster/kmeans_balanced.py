@@ -14,12 +14,22 @@ vectorized — small clusters are detected with a size threshold and their
 centers replaced by data points drawn (categorical, size-weighted) from large
 clusters, in one masked gather instead of the reference's sequential
 per-center scan.
+
+Training cost: the Round-6 build A/B named the EM loop's full-dataset
+assignment passes as the dominant cost of every IVF build (~22 passes,
+50.3-51.3 s of the 1M build). ``train_mode="minibatch"`` (the default via
+"auto" at scale) replaces them with rotating mini-batches — Sculley's
+web-scale k-means (WWW 2010) with the balancing re-seed preserved — so the
+EM loop touches ``batch_rows`` rows per iteration and only the final
+sharpening pass (plus the caller's list-fill assignment) walks the full
+trainset: at most two full-data passes per build.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -29,9 +39,12 @@ from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..distance.fused_nn import _fused_l2_nn
 from ..distance.pairwise import _choose_tile
+from ..obs import build as build_metrics
+from ..obs import metrics
 from ..random.rng import as_key
 
-__all__ = ["KMeansBalancedParams", "fit", "predict", "fit_predict", "build_clusters"]
+__all__ = ["KMeansBalancedParams", "fit", "predict", "fit_predict",
+           "build_clusters", "resolve_train_mode"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +60,39 @@ class KMeansBalancedParams:
     # adjust_centers' threshold logic)
     small_ratio: float = 0.25
     max_train_points: int | None = None  # subsample cap for fit (ref: IVF builds train on a subset)
+    # EM iteration cost policy (reference analogue: detail/kmeans_balanced
+    # predict_core's minibatch assignment :85, generalized to the whole EM
+    # loop per Sculley, WWW 2010):
+    #   "full"      — every EM iteration assigns the whole trainset (the
+    #                 pre-r07 behavior; ~n_iters+2 full-data passes).
+    #   "minibatch" — EM iterates over rotating ``batch_rows``-row
+    #                 mini-batches of a fixed shuffle; centers move by the
+    #                 streaming 1/c mean update, the balancing re-seed runs
+    #                 on per-batch counts (re-seeded centers reset their
+    #                 cumulative count so they re-adapt at Lloyd speed), and
+    #                 ONE full-data sharpening pass closes the fit. Total
+    #                 full-data passes: 1 here + 1 list-fill assignment in
+    #                 the caller — the "at most two" contract.
+    #   "auto"      — minibatch when the trainset exceeds 2 x batch_rows
+    #                 (below that the batches cover most of the data anyway
+    #                 and full EM is at least as accurate per wall-second).
+    train_mode: str = "auto"
+    batch_rows: int = 65536
+
+
+def resolve_train_mode(mode: str, n_train: int, batch_rows: int) -> str:
+    """Resolve the ``train_mode`` policy for a trainset size — one rule
+    shared by the single-chip fit and the distributed psum-EM drivers
+    (parallel/kmeans.py, parallel/ivf.py) so "auto" means the same thing
+    everywhere."""
+    expects(mode in ("full", "minibatch", "auto"),
+            "train_mode must be 'full', 'minibatch' or 'auto', got %r", mode)
+    expects(batch_rows >= 1, "batch_rows must be >= 1, got %d", batch_rows)
+    if mode == "auto":
+        return "minibatch" if n_train > 2 * batch_rows else "full"
+    return mode
+
+
 
 
 def _assign_labels(x, centers, tile: int, inner: bool):
@@ -57,8 +103,26 @@ def _assign_labels(x, centers, tile: int, inner: bool):
     return _fused_l2_nn(x, centers, False, tile)[1]
 
 
+def _reseed_small(centers, counts, labels_or_w, pool_vecs, key, k: int,
+                  avg: float, small_ratio: float):
+    """The balancing step (ref: adjust_centers :524), shared by both EM
+    modes: replace centers of under-populated clusters with candidate points
+    drawn from a pool, weighted by the crowdedness of each candidate's
+    cluster, via Gumbel top-k (weighted WITHOUT replacement — two small
+    clusters never re-seed to the same point, which would starve one of
+    them permanently). Returns (centers, small_mask)."""
+    small = counts < (avg * small_ratio)  # (k,)
+    logits = jnp.log(jnp.maximum(labels_or_w, 1e-6))
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, (pool_vecs.shape[0],), minval=1e-20,
+                           maxval=1.0)))
+    repl = pool_vecs[lax.top_k(logits + gumbel, k)[1]]
+    return jnp.where(small[:, None], repl, centers), small
+
+
 @functools.partial(jax.jit, static_argnames=("k", "n_iters", "small_ratio", "tile", "inner"))
 def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float, tile: int, inner: bool):
+    """Full-data EM loop (train_mode="full"); returns unsharpened centers."""
     n = x.shape[0]
     xf = x.astype(jnp.float32)
 
@@ -71,8 +135,6 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
 
         # -- balancing (ref: adjust_centers :524) --
-        avg = n / k
-        small = counts < (avg * small_ratio)  # (k,)
         key, kc, kp = jax.random.split(key, 3)
         # draw replacement points, favoring members of crowded clusters.
         # categorical(shape=(k,)) over all n logits broadcasts a (k, n)
@@ -86,15 +148,8 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         # top-k below exists to prevent
         pool_idx = jax.random.choice(kp, n, (pool,), replace=False)
         pool_w = counts[labels[pool_idx]]  # crowdedness of each candidate
-        logits = jnp.log(jnp.maximum(pool_w, 1e-6))
-        # Gumbel top-k = weighted sampling WITHOUT replacement: k distinct
-        # candidates, so two small clusters never re-seed to the same point
-        # (a duplicated center starves one of them permanently)
-        gumbel = -jnp.log(-jnp.log(
-            jax.random.uniform(kc, (pool,), minval=1e-20, maxval=1.0)))
-        repl_idx = pool_idx[lax.top_k(logits + gumbel, k)[1]]
-        repl = xf[repl_idx]
-        centers = jnp.where(small[:, None], repl, centers)
+        centers, _ = _reseed_small(centers, counts, pool_w, xf[pool_idx], kc,
+                                   k, n / k, small_ratio)
 
         # Note: no hot-cluster splitting here — actively relocating centers
         # each iteration proved unstable (center churn prevents Lloyd
@@ -104,13 +159,70 @@ def _balanced_em(x, init_centers, key, k: int, n_iters: int, small_ratio: float,
         return centers, key
 
     centers, _ = lax.fori_loop(0, n_iters, body, (init_centers.astype(jnp.float32), key))
-    # final sharpening pass without balancing so centers are true means
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("k", "n_iters", "small_ratio",
+                                             "tile", "inner", "batch"))
+def _balanced_em_minibatch(x, init_centers, key, k: int, n_iters: int,
+                           small_ratio: float, tile: int, inner: bool,
+                           batch: int):
+    """Mini-batch EM loop (train_mode="minibatch"); returns unsharpened
+    centers. Rotating batches of a fixed shuffle (every point is visited
+    before any repeats), the streaming 1/c center update (Sculley's
+    per-center learning rate, batched: c += (sum_b - n_b*c) / c_total), and
+    the same crowdedness-weighted Gumbel re-seed as the full loop — run on
+    the BATCH's counts against the batch-scaled small threshold. A re-seeded
+    center's cumulative count resets to zero so its next batch update is a
+    full replacement by the batch mean (Lloyd-speed re-adaptation instead of
+    a 1/c-crippled crawl)."""
+    n = x.shape[0]
+    key, kperm = jax.random.split(key)
+    perm = jax.random.permutation(kperm, n).astype(jnp.int32)
+    offs = jnp.arange(batch, dtype=jnp.int32)
+
+    def body(i, carry):
+        centers, ccounts, key = carry
+        idx = perm[(i * batch + offs) % n]
+        xb = jnp.take(x, idx, axis=0)
+        xbf = xb.astype(jnp.float32)
+        labels = _assign_labels(xb, centers, tile, inner)
+        onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)  # (k, b)
+        sums = onehot @ xbf
+        counts = jnp.sum(onehot, axis=1)
+        ccounts = ccounts + counts
+        # streaming mean: exact if centers were the running mean of their
+        # ccounts assigned points; counts==0 rows contribute a zero delta
+        centers = centers + (sums - counts[:, None] * centers) / jnp.maximum(
+            ccounts, 1.0)[:, None]
+
+        # -- balancing on batch statistics --
+        key, kc, kp = jax.random.split(key, 3)
+        pool = min(max(4 * k, 4096), batch)
+        pool_idx = jax.random.choice(kp, batch, (pool,), replace=False)
+        pool_w = counts[labels[pool_idx]]
+        centers, small = _reseed_small(centers, counts, pool_w, xbf[pool_idx],
+                                       kc, k, batch / k, small_ratio)
+        ccounts = jnp.where(small, 0.0, ccounts)
+        return centers, ccounts, key
+
+    centers, _, _ = lax.fori_loop(
+        0, n_iters, body,
+        (init_centers.astype(jnp.float32), jnp.zeros((k,), jnp.float32), key))
+    return centers
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile", "inner"))
+def _final_sharpen(x, centers, k: int, tile: int, inner: bool):
+    """One full-data pass without balancing so centers are true means — the
+    single full-trainset pass both EM modes close with."""
+    xf = x.astype(jnp.float32)
     labels = _assign_labels(x, centers, tile, inner)
     onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)
     sums = onehot @ xf
     counts = jnp.sum(onehot, axis=1)
-    centers = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers)
-    return centers
+    return jnp.where(counts[:, None] > 0,
+                     sums / jnp.maximum(counts, 1.0)[:, None], centers)
 
 
 def fit(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None = None):
@@ -135,10 +247,38 @@ def fit(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None 
     init_idx = jax.random.choice(ki, n, (n_clusters,), replace=False)
     init_centers = jnp.take(x, init_idx, axis=0)
     tile = _choose_tile(n, n_clusters, 1, res.workspace_bytes)
-    return _balanced_em(
-        x, init_centers, ke, n_clusters, params.n_iters, params.small_ratio, tile,
-        _is_inner(params.metric),
-    )
+    inner = _is_inner(params.metric)
+    mode = resolve_train_mode(params.train_mode, n, params.batch_rows)
+    t0 = time.perf_counter()
+    if mode == "minibatch":
+        # the balancing pool (and the Gumbel top-k over it) needs at least
+        # n_clusters candidates per batch
+        batch = min(n, max(params.batch_rows, n_clusters))
+        centers = _balanced_em_minibatch(
+            x, init_centers, ke, n_clusters, params.n_iters,
+            params.small_ratio, min(tile, batch), inner, batch)
+        em_rows = batch
+    else:
+        centers = _balanced_em(
+            x, init_centers, ke, n_clusters, params.n_iters,
+            params.small_ratio, tile, inner)
+        em_rows = n
+    if metrics._enabled:
+        jax.block_until_ready(centers)
+        build_metrics.build_phase().observe(time.perf_counter() - t0,
+                                 phase="kmeans_balanced/em")
+        build_metrics.assignment_passes().inc(params.n_iters, phase="em", mode=mode,
+                               driver="single")
+        build_metrics.sampled_rows().set(em_rows, mode=mode, driver="single")
+    t0 = time.perf_counter()
+    centers = _final_sharpen(x, centers, n_clusters, tile, inner)
+    if metrics._enabled:
+        jax.block_until_ready(centers)
+        build_metrics.build_phase().observe(time.perf_counter() - t0,
+                                 phase="kmeans_balanced/final")
+        build_metrics.assignment_passes().inc(1, phase="final", mode=mode,
+                               driver="single")
+    return centers
 
 
 def _is_inner(metric: str) -> bool:

@@ -29,6 +29,7 @@ from ..config import auto_convert_output
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +42,8 @@ from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               serialize_header, serialize_mdspan, serialize_scalar)
 from ..distance.types import DistanceType, resolve_metric
+from ..obs import build as _build_metrics
+from ..obs import metrics as _metrics
 from ..obs.instrument import dtype_of, instrument, nrows
 from ..random.rng import as_key
 from . import ivf_pq as ivf_pq_mod
@@ -107,6 +110,15 @@ class IndexParams:
     #              exactly the composition the r06 workaround unlocked).
     #   "xla"    — force lax.top_k.
     build_select_impl: str = "auto"
+    # coarse-trainer EM policy for the build's internal IVF-PQ index
+    # (ivf_pq.IndexParams.kmeans_train_mode/kmeans_batch_rows — same
+    # contract): "auto" runs mini-batch EM above 2 x batch_rows trainset
+    # rows, so the 1M self-search index build sheds its ~20 full-trainset
+    # assignment passes. Build speed is a serving feature here: the stream
+    # Compactor's CAGRA rebuild path means this wall bounds sustainable
+    # write churn (docs/streaming.md).
+    build_kmeans_train_mode: str = "auto"
+    build_kmeans_batch_rows: int = 65536
     seed: int = 0
 
 
@@ -251,6 +263,8 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
             n_lists=n_lists,
             metric=params.metric,
             pq_bits=pq_bits,
+            kmeans_train_mode=params.build_kmeans_train_mode,
+            kmeans_batch_rows=params.build_kmeans_batch_rows,
             seed=params.seed,
         ),
         x,
@@ -266,12 +280,31 @@ def build_knn_graph(params: IndexParams, dataset, res: Resources | None = None):
     chunk = max(int(params.build_chunk), 1)
     mt = resolve_metric(params.metric)
 
+    # per-chunk walls force a host-device sync per chunk (they would break
+    # the async dispatch pipeline on EVERY build — metrics are on by
+    # default), so they are strictly opt-in: set RAFT_TPU_BUILD_CHUNK_WALLS=1
+    # when profiling. The always-on phase wall is the single total
+    # "cagra/knn_graph" observation in build() — one sync per build.
+    import os
+
+    chunk_walls = (_metrics._enabled
+                   and os.environ.get("RAFT_TPU_BUILD_CHUNK_WALLS", "") == "1")
+
     def chunk_step(s, probes):
         xb = x[s:s + chunk]
         rows = jnp.arange(s, min(s + chunk, n), dtype=jnp.int32)
-        return _build_chunk_step(x, pq, xb, rows, probes, int(gpu_top_k),
-                                 int(k), mt, int(res.workspace_bytes),
-                                 params.build_select_impl)
+        if not chunk_walls:
+            return _build_chunk_step(x, pq, xb, rows, probes, int(gpu_top_k),
+                                     int(k), mt, int(res.workspace_bytes),
+                                     params.build_select_impl)
+        t0 = time.perf_counter()
+        out = _build_chunk_step(x, pq, xb, rows, probes, int(gpu_top_k),
+                                int(k), mt, int(res.workspace_bytes),
+                                params.build_select_impl)
+        jax.block_until_ready(out)
+        _build_metrics.build_phase().observe(time.perf_counter() - t0,
+                                             phase="cagra/knn_chunk")
+        return out
 
     probes = int(params.build_n_probes)
     parts = []
@@ -562,11 +595,21 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 
         kind = str(x.dtype)
         x = _as_signed(x)  # stored (and scored) in the shifted s8 domain
+    t0 = time.perf_counter()
     with tracing.range("cagra.build.knn_graph"):
         knn_graph = build_knn_graph(params, x, res=res)
+    if _metrics._enabled:
+        jax.block_until_ready(knn_graph)
+        _build_metrics.build_phase().observe(time.perf_counter() - t0,
+                                             phase="cagra/knn_graph")
     hint = estimate_seed_pool(x, knn_graph, seed=params.seed)
+    t0 = time.perf_counter()
     with tracing.range("cagra.build.optimize"):
         graph = optimize(knn_graph, params.graph_degree, res=res)
+    if _metrics._enabled:
+        jax.block_until_ready(graph)
+        _build_metrics.build_phase().observe(time.perf_counter() - t0,
+                                 phase="cagra/optimize")
     return CagraIndex(dataset=x, graph=graph, metric=mt, data_kind=kind,
                       seed_pool_hint=hint)
 

@@ -56,6 +56,13 @@ class IndexParams:
     metric: str | DistanceType = "sqeuclidean"
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
+    # coarse-trainer EM cost policy (KMeansBalancedParams.train_mode /
+    # batch_rows): "auto" runs mini-batch EM when the trainset exceeds
+    # 2 x kmeans_batch_rows — at 1M scale this collapses the ~22
+    # full-trainset assignment passes (the Round-6-measured dominant build
+    # cost) to the two closing passes. "full" pins the pre-r07 behavior.
+    kmeans_train_mode: str = "auto"
+    kmeans_batch_rows: int = 65536
     add_data_on_build: bool = True
     seed: int = 0
     # storage dtype of list vectors (reference: the float/half/int8_t/uint8_t
@@ -142,6 +149,23 @@ class IvfFlatIndex:
                    data_kind=kind)
 
 
+def _count_fill_pass(kb: KMeansBalancedParams, n: int) -> None:
+    """Count the build's list-fill assignment pass (one full-dataset
+    nearest-center pass outside the trainer's fit) under the same
+    raft_tpu_build_* series the trainer emits, so driver="single" and
+    driver="distributed" report the identical em/final/fill decomposition
+    (docs/observability.md). Shared by the ivf_flat and ivf_pq builds."""
+    from ..obs import build as build_metrics
+    from ..obs import metrics as _metrics
+
+    if not _metrics._enabled:
+        return
+    mode = kmeans_balanced.resolve_train_mode(
+        kb.train_mode, min(kb.max_train_points or n, n), kb.batch_rows)
+    build_metrics.assignment_passes().inc(1, phase="fill", mode=mode,
+                                          driver="single")
+
+
 @functools.partial(jax.jit, static_argnames=("n_lists", "capacity"))
 def _fill_lists(x, ids, labels, n_lists: int, capacity: int):
     """Scatter vectors into padded lists (ref: ivf_flat_build.cuh:160
@@ -223,9 +247,13 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
     kb = KMeansBalancedParams(
         n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
         max_train_points=min(max_train, n),
+        train_mode=params.kmeans_train_mode,
+        batch_rows=params.kmeans_batch_rows,
     )
     with tracing.range("ivf_flat.build.coarse_kmeans"):
         centers = kmeans_balanced.fit(kb, xf, params.n_lists, res=res)
+    if params.add_data_on_build:
+        _count_fill_pass(kb, n)
 
     storage = {"bfloat16": jnp.bfloat16, "int8": jnp.int8,
                "uint8": jnp.int8}.get(kind, x.dtype)

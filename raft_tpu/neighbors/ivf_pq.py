@@ -74,6 +74,14 @@ class IndexParams:
     force_random_rotation: bool = False  # ref :98
     kmeans_n_iters: int = 20
     kmeans_trainset_fraction: float = 0.5
+    # coarse-trainer EM cost policy (KMeansBalancedParams.train_mode /
+    # batch_rows; see ivf_flat.IndexParams — same contract): "auto" =
+    # mini-batch EM above 2 x kmeans_batch_rows trainset rows, cutting the
+    # ~22 full-trainset assignment passes to the two closing passes. The
+    # PQ codebook trainers are untouched (they already train on a pooled
+    # subsample).
+    kmeans_train_mode: str = "auto"
+    kmeans_batch_rows: int = 65536
     add_data_on_build: bool = True
     seed: int = 0
     # capacity bound for sub-list splitting (multiple of mean list size, see
@@ -352,6 +360,7 @@ def _train_codebooks_batched(subvecs, key, n_codes: int, n_iters: int):
     return jax.vmap(one)(subvecs.astype(jnp.float32), keys)
 
 
+@functools.partial(jax.jit, static_argnames=("n_iters", "refine_rounds"))
 def _train_split_codebooks(subvecs, key, n_iters: int, refine_rounds: int = 3):
     """Two-stage 4+4-bit residual codebooks (pq8_split): stage 1 is 16-means
     over the subvectors, stage 2 is 16-means over the stage-1 residuals
@@ -493,6 +502,7 @@ def _per_list_residual_scales(resid, labels, n_lists: int):
     return jnp.sqrt(jnp.maximum(msq / d_rot, 1e-24))
 
 
+@functools.partial(jax.jit, static_argnames=("per_cluster",))
 def _pq_cross_consts(codes, codebooks, labels, per_cluster: bool):
     """Per-vector scan constant for split L2 scoring: sum_s 2*cb1[s,hi_s]·
     cb2[s,lo_s] — the cross term of ||cb1+cb2||^2 that the separated hi/lo
@@ -642,9 +652,15 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     kb = KMeansBalancedParams(
         n_iters=params.kmeans_n_iters, metric=train_metric, seed=params.seed,
         max_train_points=min(max_train, n),
+        train_mode=params.kmeans_train_mode,
+        batch_rows=params.kmeans_batch_rows,
     )
     with tracing.range("ivf_pq.build.coarse_kmeans"):
         centers = kmeans_balanced.fit(kb, x, params.n_lists, res=res)
+    if params.add_data_on_build:
+        from .ivf_flat import _count_fill_pass
+
+        _count_fill_pass(kb, n)
 
     # 2. rotation (ref step 3)
     key, kr = jax.random.split(key)

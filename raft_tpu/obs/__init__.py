@@ -25,6 +25,7 @@ instrumented call is a single module-flag check (guarded by the
 metric catalogue.
 """
 
+from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
 from . import metrics
 from .compile import CompileRecord, attribution

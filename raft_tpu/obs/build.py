@@ -1,0 +1,39 @@
+"""Shared build-cost metrics (the ``raft_tpu_build_*`` catalogue,
+docs/observability.md): emitted by the balanced coarse trainer
+(cluster/kmeans_balanced), the distributed psum-EM drivers (parallel/ivf)
+and the CAGRA build (neighbors/cagra). One home so no build subsystem
+reaches into another's private helpers for a metric handle."""
+
+from __future__ import annotations
+
+import functools
+
+from . import metrics
+
+__all__ = ["assignment_passes", "sampled_rows", "build_phase"]
+
+
+@functools.lru_cache(maxsize=None)
+def assignment_passes():
+    return metrics.counter(
+        "raft_tpu_build_assignment_passes_total",
+        "coarse-trainer assignment passes by phase (em = one per EM "
+        "iteration, final = the closing sharpening pass, fill = the "
+        "list-fill assignment) and rows walked per pass (mode=full walks "
+        "the trainset, minibatch one batch)")
+
+
+@functools.lru_cache(maxsize=None)
+def sampled_rows():
+    return metrics.gauge(
+        "raft_tpu_build_sampled_rows",
+        "rows the coarse trainer assigns per EM iteration (batch_rows in "
+        "minibatch mode, the whole trainset in full mode)", unit="rows")
+
+
+@functools.lru_cache(maxsize=None)
+def build_phase():
+    return metrics.histogram(
+        "raft_tpu_build_phase_seconds",
+        "per-phase build walls (coarse trainer EM/final pass, CAGRA knn "
+        "chunk loop / optimize)", unit="seconds")

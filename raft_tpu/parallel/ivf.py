@@ -362,42 +362,90 @@ def _pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
 
 def _pooled_balanced_centers(comms: Comms, x_shard, keys, L: int,
                              n_iters: int, small_ratio: float, n_global: int,
-                             sub: int, inner: bool, tile: int):
+                             sub: int, inner: bool, tile: int,
+                             batch_shard: int = 0):
     """Distributed balanced EM (inside shard_map). Returns replicated
     (centers, labels_shard, global_counts). Deterministic: all replicated
-    math consumes identical inputs (allgathered pool, psum'd stats)."""
-    from ..cluster.kmeans_balanced import _assign_labels
+    math consumes identical inputs (allgathered pool, psum'd stats).
+
+    ``batch_shard > 0`` selects mini-batch EM (the distributed twin of
+    kmeans_balanced._balanced_em_minibatch): every iteration assigns one
+    rotating ``batch_shard``-row mini-batch per shard (a fixed per-shard
+    shuffle), the psum'd batch sums/counts drive the streaming 1/c center
+    update, and the balancing re-seed runs on the psum'd batch counts
+    against the batch-scaled threshold — so the EM loop's full-dataset
+    passes (the Round-6-measured ~22-pass, +187%-warm overhead) collapse to
+    the two closing passes (sharpening + list-fill labels) below."""
+    from ..cluster.kmeans_balanced import _assign_labels, _reseed_small
 
     xf = x_shard.astype(jnp.float32)
+    shard_rows = x_shard.shape[0]
     ksub = jax.random.fold_in(keys[0], comms.rank())
-    idx = jax.random.choice(ksub, x_shard.shape[0], (sub,), replace=False)
+    idx = jax.random.choice(ksub, shard_rows, (sub,), replace=False)
     pool = comms.allgather(jnp.take(xf, idx, axis=0), tiled=True)  # (S*sub, d)
     init_idx = jax.random.choice(keys[1], pool.shape[0], (L,), replace=False)
     centers0 = jnp.take(pool, init_idx, axis=0)
     ptile = min(tile, pool.shape[0])
+    S = comms.size()
 
-    def body(i, carry):
-        centers, key = carry
-        labels = _assign_labels(x_shard, centers, tile, inner)
-        onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
-        sums = comms.allreduce(onehot @ xf)
-        counts = comms.allreduce(jnp.sum(onehot, axis=1))
-        centers = jnp.where(counts[:, None] > 0,
-                            sums / jnp.maximum(counts, 1.0)[:, None], centers)
-        # balancing (single-chip _balanced_em's pool trick, already sized
-        # for this): re-seed small clusters from the replicated pooled
-        # subsample, weighted by crowdedness, Gumbel top-k for distinctness
-        key, kc = jax.random.split(key)
-        pool_w = counts[_assign_labels(pool, centers, ptile, inner)]
-        logits = jnp.log(jnp.maximum(pool_w, 1e-6))
-        gumbel = -jnp.log(-jnp.log(jax.random.uniform(
-            kc, (pool.shape[0],), minval=1e-20, maxval=1.0)))
-        repl = pool[lax.top_k(logits + gumbel, L)[1]]
-        small = counts < (n_global / L) * small_ratio
-        centers = jnp.where(small[:, None], repl, centers)
-        return centers, key
+    if batch_shard:
+        kperm = jax.random.fold_in(keys[0], comms.rank() + S)
+        perm = jax.random.permutation(kperm, shard_rows).astype(jnp.int32)
+        offs = jnp.arange(batch_shard, dtype=jnp.int32)
+        batch_global = batch_shard * S
 
-    centers, _ = lax.fori_loop(0, n_iters, body, (centers0, keys[2]))
+        def body(i, carry):
+            centers, ccounts, key = carry
+            bidx = perm[(i * batch_shard + offs) % shard_rows]
+            xb = jnp.take(xf, bidx, axis=0)
+            labels = _assign_labels(xb, centers, min(tile, batch_shard), inner)
+            onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
+            sums = comms.allreduce(onehot @ xb)
+            counts = comms.allreduce(jnp.sum(onehot, axis=1))
+            ccounts = ccounts + counts
+            # streaming 1/c mean update (exact running mean of the ccounts
+            # points each center has absorbed); zero-count rows are a no-op
+            centers = centers + (
+                sums - counts[:, None] * centers) / jnp.maximum(
+                    ccounts, 1.0)[:, None]
+            # balancing on the psum'd batch counts; replacements from the
+            # replicated pooled subsample (crowdedness-weighted Gumbel
+            # top-k, identical on every shard)
+            key, kc = jax.random.split(key)
+            pool_w = counts[_assign_labels(pool, centers, ptile, inner)]
+            centers, small = _reseed_small(
+                centers, counts, pool_w, pool, kc, L, batch_global / L,
+                small_ratio)
+            # re-seeded centers forget their history: next batch replaces
+            # them with its mean at Lloyd speed instead of a 1/c crawl
+            ccounts = jnp.where(small, 0.0, ccounts)
+            return centers, ccounts, key
+
+        centers, _, _ = lax.fori_loop(
+            0, n_iters, body,
+            (centers0, jnp.zeros((L,), jnp.float32), keys[2]))
+    else:
+        def body(i, carry):
+            centers, key = carry
+            labels = _assign_labels(x_shard, centers, tile, inner)
+            onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
+            sums = comms.allreduce(onehot @ xf)
+            counts = comms.allreduce(jnp.sum(onehot, axis=1))
+            centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts, 1.0)[:, None],
+                                centers)
+            # balancing (single-chip _balanced_em's pool trick, already sized
+            # for this): re-seed small clusters from the replicated pooled
+            # subsample, weighted by crowdedness, Gumbel top-k for
+            # distinctness
+            key, kc = jax.random.split(key)
+            pool_w = counts[_assign_labels(pool, centers, ptile, inner)]
+            centers, _ = _reseed_small(
+                centers, counts, pool_w, pool, kc, L, n_global / L,
+                small_ratio)
+            return centers, key
+
+        centers, _ = lax.fori_loop(0, n_iters, body, (centers0, keys[2]))
     # final sharpening pass without balancing so centers are true means
     labels = _assign_labels(x_shard, centers, tile, inner)
     onehot = jax.nn.one_hot(labels, L, dtype=jnp.float32, axis=0)
@@ -465,6 +513,56 @@ def _build_capacity(gcounts, extra=0) -> int:
     return round_up(max(int(np.asarray(gcounts).max()) + extra, 8), 8)
 
 
+def _resolve_batch_shard(params, n: int, S: int, shard_rows: int) -> int:
+    """Per-shard mini-batch rows for the coarse psum-EM (0 = full EM).
+    The mode/threshold rule is the single-chip trainer's
+    (kmeans_balanced.resolve_train_mode) applied to the GLOBAL row count —
+    the distributed build trains on the full sharded dataset, there is no
+    trainset-fraction subsample here."""
+    from ..cluster.kmeans_balanced import resolve_train_mode
+
+    mode = resolve_train_mode(
+        getattr(params, "kmeans_train_mode", "auto"), n,
+        getattr(params, "kmeans_batch_rows", 65536))
+    if mode != "minibatch":
+        return 0
+    batch_rows = getattr(params, "kmeans_batch_rows", 65536)
+    return min(shard_rows, max(batch_rows // S, 1))
+
+
+def _timed_coarse_em(fn, xs, keys, n_iters: int, batch_shard: int, S: int,
+                     n: int):
+    """Run the jitted coarse-EM phase with the shared build metrics
+    (assignment-pass counter, sampled-rows gauge, phase wall — the same
+    raft_tpu_build_* series the single-chip trainer emits, labeled
+    driver="distributed")."""
+    import time
+
+    from ..obs import build as build_metrics
+    from ..obs import metrics
+
+    if not metrics._enabled:
+        return fn(xs, keys)
+    mode = "minibatch" if batch_shard else "full"
+    t0 = time.perf_counter()
+    out = fn(xs, keys)
+    jax.block_until_ready(out)
+    build_metrics.build_phase().observe(time.perf_counter() - t0,
+                             phase="parallel.ivf/coarse_em")
+    build_metrics.assignment_passes().inc(n_iters, phase="em", mode=mode,
+                                          driver="distributed")
+    # the two closing full passes ride inside the same program, counted
+    # under the SAME phase labels the single-chip driver uses (final =
+    # sharpening, fill = list-fill assignment) so the series compare 1:1
+    build_metrics.assignment_passes().inc(1, phase="final", mode=mode,
+                                          driver="distributed")
+    build_metrics.assignment_passes().inc(1, phase="fill", mode=mode,
+                                          driver="distributed")
+    build_metrics.sampled_rows().set(batch_shard * S if batch_shard else n, mode=mode,
+                          driver="distributed")
+    return out
+
+
 @instrument("parallel.ivf.build",
             items=lambda a, kw: nrows(a[2] if len(a) > 2 else kw["dataset"]),
             labels=lambda a, kw: {"size": (a[0] if a else kw["comms"]).size()})
@@ -495,18 +593,21 @@ def build(comms: Comms, params, dataset, res=None) -> IvfFlatIndex:
     shard_rows = n // S
     sub = min(max(8 * L // S, 64), shard_rows)
     tile = _choose_tile(shard_rows, L, 1, 1 << 28)
+    batch_shard = _resolve_batch_shard(params, n, S, shard_rows)
 
     def phase1(x_shard, keys):
         return _pooled_balanced_centers(
             comms, x_shard, keys, L, params.kmeans_n_iters, 0.25, n, sub,
-            inner, tile)
+            inner, tile, batch_shard=batch_shard)
 
     keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
     xs = shard_along(mesh, axis, x)
     with tracing.range("parallel.ivf.build.coarse_kmeans"):
-        centers, labels, gcounts = jax.jit(comms.shard_map(
-            phase1, in_specs=(P(axis), P()),
-            out_specs=(P(), P(axis), P())))(xs, keys)
+        centers, labels, gcounts = _timed_coarse_em(
+            jax.jit(comms.shard_map(
+                phase1, in_specs=(P(axis), P()),
+                out_specs=(P(), P(axis), P()))),
+            xs, keys, params.kmeans_n_iters, batch_shard, S, n)
     cap = _build_capacity(gcounts)
 
     def phase3(x_shard, lab, ids):
@@ -667,17 +768,21 @@ def build_pq(comms: Comms, params, dataset, res=None):
     tile = _choose_tile(shard_rows, L, 1, 1 << 28)
 
     # phase 1: coarse centers (identical machinery to the flat build)
+    batch_shard = _resolve_batch_shard(params, n, S, shard_rows)
+
     def phase1(x_shard, keys):
         return _pooled_balanced_centers(
             comms, x_shard, keys, L, params.kmeans_n_iters, 0.25, n, sub,
-            inner, tile)
+            inner, tile, batch_shard=batch_shard)
 
     keys = replicated(mesh, jax.random.split(jax.random.key(params.seed), 3))
     xs = shard_along(mesh, axis, x)
     with tracing.range("parallel.ivf.build_pq.coarse_kmeans"):
-        centers, labels, gcounts = jax.jit(comms.shard_map(
-            phase1, in_specs=(P(axis), P()),
-            out_specs=(P(), P(axis), P())))(xs, keys)
+        centers, labels, gcounts = _timed_coarse_em(
+            jax.jit(comms.shard_map(
+                phase1, in_specs=(P(axis), P()),
+                out_specs=(P(), P(axis), P()))),
+            xs, keys, params.kmeans_n_iters, batch_shard, S, n)
     cap = _build_capacity(gcounts)
 
     # phase 2: rotation (host, deterministic from the seed — replicated

@@ -29,7 +29,16 @@ def fit(comms: Comms, params: KMeansParams, x, tile: int = 4096) -> KMeansOutput
     along ``comms.axis``). Init = k-means++ on a cross-shard subsample: each
     chip contributes random rows, the pooled candidates are allgathered
     (identical on every chip), and ++ runs replicated — no serialized
-    global D² sampling over the full dataset."""
+    global D² sampling over the full dataset.
+
+    ``params.train_mode`` (see :class:`~raft_tpu.cluster.kmeans.KMeansParams`)
+    selects mini-batch EM: each iteration assigns one rotating per-shard
+    mini-batch (``batch_rows`` rows globally) and moves centers by the
+    streaming 1/c mean update — the same full-pass elimination as the
+    balanced coarse trainer — with tol applied to the per-iteration center
+    shift; labels and inertia always come from one closing full pass."""
+    from ..cluster.kmeans_balanced import resolve_train_mode
+
     x = jnp.asarray(x)
     n, d = x.shape
     size = comms.size()
@@ -37,6 +46,9 @@ def fit(comms: Comms, params: KMeansParams, x, tile: int = 4096) -> KMeansOutput
     k = params.n_clusters
     shard_rows = n // size
     sub = min(max(8 * k, 64), shard_rows)
+    mode = resolve_train_mode(params.train_mode, n, params.batch_rows)
+    batch = (min(shard_rows, max(params.batch_rows // size, 1))
+             if mode == "minibatch" else 0)
 
     def step(x_shard, key):
         # per-shard distinct subsample → pooled ++ seeding
@@ -60,7 +72,36 @@ def fit(comms: Comms, params: KMeansParams, x, tile: int = 4096) -> KMeansOutput
             )
             return new_centers, jnp.sum(jnp.square(new_centers - centers)), it + 1
 
-        centers, _, n_iter = lax.while_loop(cond, body, (init_c, jnp.inf, 0))
+        if batch:
+            kperm = jax.random.fold_in(key[0], comms.rank() + size)
+            perm = jax.random.permutation(kperm, shard_rows).astype(jnp.int32)
+            offs = jnp.arange(batch, dtype=jnp.int32)
+
+            def mb_body(state):
+                centers, ccounts, _, it = state
+                bidx = perm[(it * batch + offs) % shard_rows]
+                xb = jnp.take(x_shard, bidx, axis=0).astype(jnp.float32)
+                _, labels = _fused_l2_nn(xb, centers, False, min(tile, batch))
+                onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32, axis=0)
+                sums = comms.allreduce(onehot @ xb, "sum")
+                counts = comms.allreduce(jnp.sum(onehot, axis=1), "sum")
+                ccounts = ccounts + counts
+                new_centers = centers + (
+                    sums - counts[:, None] * centers) / jnp.maximum(
+                        ccounts, 1.0)[:, None]
+                return (new_centers, ccounts,
+                        jnp.sum(jnp.square(new_centers - centers)), it + 1)
+
+            def mb_cond(state):
+                _, _, shift2, it = state
+                return jnp.logical_and(it < params.max_iter,
+                                       shift2 > params.tol**2)
+
+            centers, _, _, n_iter = lax.while_loop(
+                mb_cond, mb_body,
+                (init_c, jnp.zeros((k,), jnp.float32), jnp.inf, 0))
+        else:
+            centers, _, n_iter = lax.while_loop(cond, body, (init_c, jnp.inf, 0))
         d2, labels = _fused_l2_nn(x_shard, centers, False, min(tile, x_shard.shape[0]))
         inertia = comms.allreduce(jnp.sum(d2), "sum")
         return centers, labels, inertia, n_iter

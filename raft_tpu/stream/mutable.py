@@ -367,13 +367,21 @@ class MutableIndex:
     ``retain_vectors`` keeps a host-side raw row store (required for
     rebuild compaction — auto-recovered from brute-force/CAGRA sealed
     datasets, supplied via ``dataset=`` for IVF kinds, whose codes cannot
-    reconstruct rows). ``clock`` is injected for deterministic tests (the
-    age watermark's time base).
+    reconstruct rows). ``builder`` (optional) replaces the default
+    ``module.build(index_params, rows)`` in rebuild compaction: any
+    ``fn(rows, res=None) -> sealed-index-of-the-same-kind`` — the hook that
+    lets compactions rebuild SHARDED over a mesh
+    (:func:`raft_tpu.parallel.cagra.merged_builder`), shrinking the rebuild
+    wall that bounds sustainable write churn. Like ``search_params`` it is
+    runtime configuration: never serialized, supplied fresh to ``load``.
+    ``clock`` is injected for deterministic tests (the age watermark's time
+    base).
     """
 
     def __init__(self, sealed, *, search_params=None, index_params=None,
                  delta_capacity: int = 1024, retain_vectors: bool | None = None,
-                 dataset=None, name: str = "default",
+                 dataset=None, builder: Callable | None = None,
+                 name: str = "default",
                  clock: Callable[[], float] = time.monotonic):
         kind, module = _resolve_kind(sealed)
         n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
@@ -398,6 +406,9 @@ class MutableIndex:
                       name=name)
         self._cfg = cfg
         self._index_params = index_params
+        expects(builder is None or callable(builder),
+                "builder must be a callable fn(rows, res=None) -> sealed index")
+        self._builder = builder
         self.delta_capacity = int(delta_capacity)
         self._buckets = delta_buckets(self.delta_capacity)
         self._clock = clock
@@ -468,7 +479,8 @@ class MutableIndex:
         if st.store is None:
             return False
         return (self._cfg.kind in ("brute_force", "cagra")
-                or self._index_params is not None)
+                or self._index_params is not None
+                or self._builder is not None)
 
     @property
     def size(self) -> int:
@@ -741,7 +753,13 @@ class MutableIndex:
                 new_store = live_rows
                 reclaimed = len(st.id_map) - len(s_src)
                 x = jnp.asarray(live_rows)
-                if cfg.kind == "brute_force":
+                if self._builder is not None:
+                    new_sealed = self._builder(x, res=res)
+                    got_kind, _ = _resolve_kind(new_sealed)
+                    expects(got_kind == cfg.kind,
+                            "builder returned a %s index for a %s mutable "
+                            "index", got_kind, cfg.kind)
+                elif cfg.kind == "brute_force":
                     from ..neighbors import brute_force
 
                     new_sealed = brute_force.BruteForce(
@@ -835,11 +853,11 @@ def save(mutable: MutableIndex, path: str) -> None:
 
 
 def load(path: str, *, search_params=None, index_params=None,
-         name: str | None = None,
+         builder: Callable | None = None, name: str | None = None,
          clock: Callable[[], float] = time.monotonic) -> MutableIndex:
     """Load a :func:`save`d mutable index. ``search_params``/
-    ``index_params`` are runtime configuration (like every other index
-    loader) and are supplied fresh here."""
+    ``index_params``/``builder`` are runtime configuration (like every other
+    index loader) and are supplied fresh here."""
     from ..core.serialize import (check_header, deserialize_mdspan,
                                   deserialize_scalar)
     from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -864,7 +882,7 @@ def load(path: str, *, search_params=None, index_params=None,
 
     m = MutableIndex(sealed, search_params=search_params,
                      index_params=index_params, delta_capacity=capacity,
-                     retain_vectors=has_store, dataset=store,
+                     retain_vectors=has_store, dataset=store, builder=builder,
                      name=saved_name if name is None else name, clock=clock)
     with m._lock:
         st = m._state

@@ -277,9 +277,10 @@ def test_serve_churn_row_smoke():
     assert row["recall_mut"] > 0.3, row
 
 
-def test_serve_churn_flag_runs_only_the_churn_row(monkeypatch):
+def test_serve_churn_flag_runs_only_the_churn_rows(monkeypatch):
     """`bench.py --serve-churn` is the stream parameter-iteration loop:
-    setup + the churn row, nothing else."""
+    setup + the two churn rows (IVF-PQ extend folds, CAGRA rebuild folds),
+    nothing else."""
     import bench
 
     calls = []
@@ -288,12 +289,94 @@ def test_serve_churn_flag_runs_only_the_churn_row(monkeypatch):
         bench, "_row_serve_churn",
         lambda rows: rows.append({"name": "serve_churn_ivf_pq_100k",
                                   "qps": 1.0}))
+    monkeypatch.setattr(
+        bench, "_row_serve_churn_cagra",
+        lambda rows: rows.append({"name": "serve_churn_cagra_100k",
+                                  "qps": 1.0}))
     monkeypatch.setattr(bench, "_run",
                         lambda rows: calls.append("run"))  # must NOT fire
     try:
         rc = bench.main(["--serve-churn"])
         assert rc == 0 and calls == ["setup"]
-        assert any(r.get("name") == "serve_churn_ivf_pq_100k"
-                   for r in bench._STATE["rows"])
+        names = {r.get("name") for r in bench._STATE["rows"]}
+        assert {"serve_churn_ivf_pq_100k", "serve_churn_cagra_100k"} <= names
     finally:
         bench._STATE["rows"].clear()
+
+
+def test_serve_churn_cagra_row_smoke():
+    """The --serve-churn CAGRA row (ISSUE 6 acceptance measurement): same
+    protocol as the IVF-PQ churn smoke, but every compaction is a REBUILD
+    (no extend for graphs) — so the row proves the rehearsal covers the
+    per-epoch rebuild program set too: >= 2 swaps, zero failed queries,
+    zero cold compiles across the loaded window. Shrunk shapes; the
+    absolute numbers are the TPU driver row's job."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_serve_churn_cagra(rows, n=2500, d=32, k=5, itopk=16, threads=3,
+                                 writer_steps=12, upserts_per_step=24,
+                                 deletes_per_step=8, delta_capacity=128,
+                                 compact_fill=0.75, max_batch=8,
+                                 max_wait_us=500.0, ncl=32, n_eval=64)
+    row = rows[-1]
+    assert row["name"] == "serve_churn_cagra_100k" and "error" not in row, rows
+    assert row["churn"]["failed"] == 0, row
+    assert row["churn"]["compactions"] >= 2, row
+    # zero cold compiles across the whole loaded window — every rebuild
+    # fold, its publish warm + flip, and every flush (rehearsal-compiled)
+    assert row["churn"]["compile_s"] == 0.0, row
+    assert row["churn"]["cache_misses"] == 0, row
+    assert row["qps"] > 0 and row["write_rows_per_s"] > 0, row
+    # rebuild compactions actually rebuilt (tombstones reclaimed -> the
+    # sealed row count tracks the live set, not a monotone append)
+    assert all(w > 0 for w in row["churn"]["compaction_wall_s"]), row
+    # exact sealed kind: rebuild-over-live-rows keeps recall at the fresh
+    # -oracle point (CAGRA rebuild IS a fresh build over the live rows)
+    assert abs(row["recall_gap"]) < 0.05, row
+
+
+def test_build_ab_table_renders_from_artifact():
+    """bench/build_ab.py --table: the BASELINE Round-6 follow-up table is
+    generated FROM the artifact (no prose drift) — pure stdlib, no jax."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "build_ab", pathlib.Path(__file__).resolve().parents[1]
+        / "bench" / "build_ab.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    artifact = {
+        "elapsed_s": 12.3,
+        "config": {"n": [1000], "d": 8},
+        "rows": [
+            {"name": "em_ab_ivf_pq_100k",
+             "full": {"warm_s": 10.0, "cold_s": 20.0, "recall": 0.98},
+             "minibatch": {"warm_s": 6.0, "cold_s": 9.0, "recall": 0.979},
+             "warm_cut": 0.4, "recall_gap": -0.001},
+            {"name": "dist_overhead_100k",
+             "full": {"single": {"warm_s": 10.0, "cold_s": 20.0},
+                      "distributed": {"warm_s": 28.7, "cold_s": 40.0},
+                      "warm_overhead": 1.87},
+             "minibatch": {"single": {"warm_s": 6.0, "cold_s": 9.0},
+                           "distributed": {"warm_s": 6.6, "cold_s": 10.0},
+                           "warm_overhead": 0.1}},
+            {"name": "cagra_build_ab_1000k", "shards": 8,
+             "single": {"warm_s": 135.0, "cold_s": 300.0, "recall": 0.9714},
+             "merged": {"warm_s": 50.0, "cold_s": 90.0, "recall": 0.9714},
+             "warm_cut": 0.63, "recall_gap": 0.0},
+            {"name": "em_ab_ivf_pq_1000k", "error": "RuntimeError: boom"},
+        ],
+    }
+    table = mod.render_table(artifact)
+    # every arm's numbers ride verbatim; the header names the generator
+    for needle in ("em_ab_ivf_pq_100k", "warm_cut **0.4**", "0.9790",
+                   "warm_overhead **0.1**", "cagra_build_ab_1000k",
+                   "warm_cut **0.63**", "ERROR", "build_ab.py --table"):
+        assert needle in table, (needle, table)
+    # a markdown table: header + separator + one line per arm
+    assert table.count("|") > 30

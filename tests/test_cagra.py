@@ -593,3 +593,87 @@ class TestSampleFilter:
         overlap = np.mean([len(set(i_x[r]) & set(i_f[r])) / 10
                            for r in range(i_x.shape[0])])
         assert overlap > 0.95, overlap
+
+
+class TestMergedShardedBuild:
+    """parallel.cagra.build_merged (ISSUE 6): per-shard graphs concatenated
+    into ONE plain CagraIndex — every single-chip consumer takes it
+    unchanged, and the scored seed pool (spanning all shards) keeps recall
+    at parity with a global build (the r06 64k/8 measured result)."""
+
+    @pytest.fixture(scope="class")
+    def mdata(self):
+        rng = np.random.default_rng(3)
+        centers = rng.random((16, 16)).astype(np.float32) * 10
+        lab = rng.integers(0, 16, 2000)
+        x = (centers[lab] + 0.3 * rng.standard_normal((2000, 16))).astype(
+            np.float32)
+        return x
+
+    @pytest.fixture(scope="class")
+    def merged(self, mdata):
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.parallel import cagra as pcagra
+
+        comms = Comms(Mesh(np.array(jax.devices()[:8]), ("data",)), "data")
+        params = cagra.IndexParams(intermediate_graph_degree=16,
+                                   graph_degree=8, build_chunk=1024, seed=0)
+        return pcagra.build_merged(comms, params, mdata)
+
+    def test_structure_and_shard_locality(self, mdata, merged):
+        from raft_tpu.parallel import cagra as pcagra
+
+        n = mdata.shape[0]
+        assert merged.dataset.shape == (n, 16)
+        assert merged.graph.shape == (n, 8)
+        g = np.asarray(merged.graph)
+        assert g.min() >= 0 and g.max() < n
+        # uneven shards allowed (2000 / 8 = 250): edges stay within their
+        # owning shard's global row range — no cross-shard edges by
+        # construction
+        for lo, hi in pcagra._shard_bounds(n, 8):
+            assert g[lo:hi].min() >= lo and g[lo:hi].max() < hi, (lo, hi)
+        # the merged dataset preserves the original row order
+        np.testing.assert_array_equal(np.asarray(merged.dataset), mdata)
+
+    def test_search_recall_parity_vs_single(self, mdata, merged):
+        from raft_tpu.neighbors import brute_force
+
+        params = cagra.IndexParams(intermediate_graph_degree=16,
+                                   graph_degree=8, build_chunk=1024, seed=0)
+        single = cagra.build(params, mdata)
+        q = mdata[:64]
+        _, gt = brute_force.knn(mdata, q, 5)
+        gt = np.asarray(gt)
+        sp = cagra.SearchParams(itopk_size=16)
+
+        def rec(idx):
+            _, ids = cagra.search(sp, idx, q, 5)
+            return _recall(np.asarray(ids), gt)
+
+        r_merged, r_single = rec(merged), rec(single)
+        assert r_merged > 0.8, r_merged
+        assert r_merged >= r_single - 0.03, (r_merged, r_single)
+
+    def test_uneven_rows_and_degree_bound(self, mdata):
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.core import RaftError
+        from raft_tpu.parallel import cagra as pcagra
+
+        comms = Comms(Mesh(np.array(jax.devices()[:8]), ("data",)), "data")
+        # 2001 rows over 8 shards: bounds cover every row exactly once
+        bounds = pcagra._shard_bounds(2001, 8)
+        assert bounds[0] == (0, 251) and bounds[-1] == (1751, 2001)
+        assert sum(hi - lo for lo, hi in bounds) == 2001
+        # graph_degree must fit the SMALLEST shard
+        with pytest.raises(RaftError):
+            pcagra.build_merged(
+                comms, cagra.IndexParams(intermediate_graph_degree=16,
+                                         graph_degree=8, seed=0),
+                mdata[:40])  # 5-row shards < graph_degree

@@ -495,3 +495,63 @@ class TestSampleFilterEquivalence:
         d, i = ivf_flat.search(
             ivf_flat.SearchParams(n_probes=64), idx, q, 10, sample_filter=keep)
         check_filter_underfill(d, i, alive, select_min=False)
+
+
+class TestMinibatchEm:
+    """Mini-batch coarse EM (ISSUE 6): the 100k recall anchor must hold
+    within tolerance vs full EM — the build got faster, not worse. The
+    heavy 1M case lives in the slow manifest (test_minibatch_em_1m)."""
+
+    def test_minibatch_recall_parity_100k(self):
+        import dataclasses
+
+        from raft_tpu.neighbors import brute_force
+
+        n, d, k = 100_000, 32, 10
+        x, _ = make_blobs(n, d, n_clusters=500, cluster_std=1.0, seed=7)
+        x = np.asarray(x)
+        q = x[:300]
+        _, gt = brute_force.knn(x, q, k)
+        gt = np.asarray(gt)
+        base = ivf_flat.IndexParams(n_lists=256, seed=0,
+                                    kmeans_batch_rows=8192)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        recs = {}
+        for mode in ("full", "minibatch"):
+            idx = ivf_flat.build(
+                dataclasses.replace(base, kmeans_train_mode=mode), x)
+            _, ids = ivf_flat.search(sp, idx, q, k)
+            recs[mode] = _recall(np.asarray(ids), gt)
+            del idx
+        assert recs["minibatch"] > 0.8, recs
+        assert recs["minibatch"] >= recs["full"] - 0.02, recs
+
+
+@pytest.mark.slow
+def test_minibatch_em_auto_at_scale():
+    """Heavy case (slow manifest): at 300k the AUTO default resolves to
+    mini-batch (trainset 150k > 2 x 65536) — the production default path —
+    and the recall anchor holds vs a pinned full-EM build."""
+    import dataclasses
+
+    from raft_tpu.cluster.kmeans_balanced import resolve_train_mode
+    from raft_tpu.neighbors import brute_force
+
+    n, d, k = 300_000, 32, 10
+    assert resolve_train_mode("auto", n // 2, 65536) == "minibatch"
+    x, _ = make_blobs(n, d, n_clusters=1000, cluster_std=1.0, seed=5)
+    x = np.asarray(x)
+    q = x[:200]
+    _, gt = brute_force.knn(x, q, k)
+    gt = np.asarray(gt)
+    base = ivf_flat.IndexParams(n_lists=512, seed=0)  # auto -> minibatch
+    sp = ivf_flat.SearchParams(n_probes=8)
+    recs = {}
+    for mode in ("auto", "full"):
+        idx = ivf_flat.build(
+            dataclasses.replace(base, kmeans_train_mode=mode), x)
+        _, ids = ivf_flat.search(sp, idx, q, k)
+        recs[mode] = _recall(np.asarray(ids), gt)
+        del idx
+    assert recs["auto"] > 0.8, recs
+    assert recs["auto"] >= recs["full"] - 0.02, recs

@@ -107,3 +107,109 @@ class TestKMeansBalanced:
         centers = kmeans_balanced.fit(params, np.asarray(x), 8)
         assert centers.shape == (8, 5)
         assert np.isfinite(np.asarray(centers)).all()
+
+
+class TestKMeansBalancedMinibatch:
+    """Mini-batch EM (ISSUE 6 tentpole): the rotating-batch trainer must
+    preserve the balanced trainer's contract — partition quality and the
+    balance property — while the EM loop stops walking the full trainset."""
+
+    def test_params_defaults_drift(self):
+        """The r07 drift pin (bench/kmeans_1m.py exercises the new path;
+        --full-em is the explicit escape hatch): mini-batch-by-auto IS the
+        default, and the build-params threading carries it everywhere."""
+        p = KMeansBalancedParams()
+        assert p.train_mode == "auto"
+        assert p.batch_rows == 65536
+        assert p.n_iters == 20 and p.small_ratio == 0.25
+        from raft_tpu.neighbors import cagra, ivf_flat, ivf_pq
+
+        for ip in (ivf_flat.IndexParams(), ivf_pq.IndexParams()):
+            assert ip.kmeans_train_mode == "auto"
+            assert ip.kmeans_batch_rows == 65536
+        cp = cagra.IndexParams()
+        assert cp.build_kmeans_train_mode == "auto"
+        assert cp.build_kmeans_batch_rows == 65536
+        # plain-Lloyd KMeansParams keeps full EM by default (tol-based
+        # convergence is its contract); the knob exists for parallel.kmeans
+        assert KMeansParams().train_mode == "full"
+
+    def test_auto_resolution_rule(self):
+        from raft_tpu.cluster.kmeans_balanced import resolve_train_mode
+
+        assert resolve_train_mode("auto", 2 * 65536, 65536) == "full"
+        assert resolve_train_mode("auto", 2 * 65536 + 1, 65536) == "minibatch"
+        assert resolve_train_mode("full", 10**9, 64) == "full"
+        assert resolve_train_mode("minibatch", 10, 64) == "minibatch"
+        with pytest.raises(RaftError):
+            resolve_train_mode("bogus", 100, 64)
+
+    def test_auto_below_threshold_is_bitwise_full(self):
+        x, _ = make_blobs(1200, 6, n_clusters=4, cluster_std=0.5, seed=3)
+        x = np.asarray(x)
+        a = kmeans_balanced.fit(
+            KMeansBalancedParams(n_iters=8, seed=1, train_mode="auto"), x, 8)
+        f = kmeans_balanced.fit(
+            KMeansBalancedParams(n_iters=8, seed=1, train_mode="full"), x, 8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(f))
+
+    def test_minibatch_quality_parity(self):
+        """Partition quality: mini-batch centers' clustering cost within a
+        few percent of full EM's on clustered data."""
+        from raft_tpu.cluster.kmeans import cluster_cost
+
+        x, _ = make_blobs(6000, 8, n_clusters=12, cluster_std=0.8, seed=11)
+        x = np.asarray(x)
+        full = kmeans_balanced.fit(
+            KMeansBalancedParams(n_iters=15, seed=2, train_mode="full"),
+            x, 24)
+        mb = kmeans_balanced.fit(
+            KMeansBalancedParams(n_iters=15, seed=2, train_mode="minibatch",
+                                 batch_rows=1024), x, 24)
+        c_full = float(cluster_cost(x, full))
+        c_mb = float(cluster_cost(x, mb))
+        assert c_mb < 1.10 * c_full, (c_mb, c_full)
+
+    def test_minibatch_balance_cap_property(self):
+        """The balance property (no empty lists, bounded skew — the IVF
+        requirement the balancing re-seed exists for) holds under
+        mini-batch EM with per-batch counts."""
+        x, _ = make_blobs(4000, 8, n_clusters=4, cluster_std=2.0, seed=5)
+        centers, labels, sizes = kmeans_balanced.build_clusters(
+            KMeansBalancedParams(n_iters=15, seed=2, train_mode="minibatch",
+                                 batch_rows=512), np.asarray(x), 16)
+        sizes = np.asarray(sizes)
+        assert sizes.sum() == 4000
+        assert sizes.min() > 0, sizes  # no empty lists — the IVF requirement
+        assert sizes.max() / max(sizes.mean(), 1) < 4.0, sizes
+
+    def test_minibatch_subsample_composes(self):
+        """max_train_points (the IVF trainset fraction) and mini-batch EM
+        compose: the batch rotates over the subsample."""
+        x, _ = make_blobs(3000, 5, n_clusters=4, seed=4)
+        params = KMeansBalancedParams(n_iters=10, max_train_points=1000,
+                                      train_mode="minibatch", batch_rows=256)
+        centers = kmeans_balanced.fit(params, np.asarray(x), 8)
+        assert centers.shape == (8, 5)
+        assert np.isfinite(np.asarray(centers)).all()
+
+
+@pytest.mark.slow
+def test_minibatch_em_1m_quality_and_auto():
+    """Heavy 1M case (slow manifest, ISSUE 6): at 1M the auto default IS
+    mini-batch (trainset > 2 x 65536), and its partition cost stays within
+    10% of full EM while touching ~1/8 of the rows per iteration."""
+    from raft_tpu.cluster.kmeans import cluster_cost
+    from raft_tpu.cluster.kmeans_balanced import resolve_train_mode
+
+    n, d, k = 1_000_000, 16, 128
+    x, _ = make_blobs(n, d, n_clusters=k, cluster_std=1.0, seed=1)
+    x = np.asarray(x)
+    assert resolve_train_mode("auto", n, 65536) == "minibatch"
+    mb = kmeans_balanced.fit(
+        KMeansBalancedParams(n_iters=10, seed=0, train_mode="auto"), x, k)
+    full = kmeans_balanced.fit(
+        KMeansBalancedParams(n_iters=10, seed=0, train_mode="full"), x, k)
+    c_mb = float(cluster_cost(x, mb))
+    c_full = float(cluster_cost(x, full))
+    assert c_mb < 1.10 * c_full, (c_mb, c_full)

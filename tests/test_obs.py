@@ -509,3 +509,52 @@ def test_logger_basic_config_formats_and_replaces():
     lg.addHandler(logging.NullHandler())
     lg.propagate = True
     lg.setLevel(logging.NOTSET)
+
+
+def test_build_metrics_coarse_trainer():
+    """raft_tpu_build_* metrics (ISSUE 6, docs/observability.md): the
+    balanced coarse trainer emits the assignment-pass counter, the
+    sampled-rows gauge, and per-phase build walls — the series a capacity
+    plan reads to verify mini-batch EM actually killed the full passes."""
+    import numpy as np
+
+    from raft_tpu.cluster import kmeans_balanced
+    from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+    from raft_tpu.obs import metrics
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2048, 8)).astype(np.float32)
+    before = obs.to_json()
+    kmeans_balanced.fit(
+        KMeansBalancedParams(n_iters=6, seed=0, train_mode="minibatch",
+                             batch_rows=256), x, 8)
+    d = obs.delta(before, obs.to_json())
+    em_key = ('raft_tpu_build_assignment_passes_total'
+              '{driver="single",mode="minibatch",phase="em"}')
+    fin_key = ('raft_tpu_build_assignment_passes_total'
+               '{driver="single",mode="minibatch",phase="final"}')
+    assert d.get(em_key) == 6.0, d
+    assert d.get(fin_key) == 1.0, d
+    # gauge: rows per EM iteration == the batch
+    snap = metrics.snapshot()["raft_tpu_build_sampled_rows"]["series"]
+    mb = [s for s in snap if s["labels"].get("mode") == "minibatch"
+          and s["labels"].get("driver") == "single"]
+    assert mb and mb[0]["value"] == 256.0, snap
+    # per-phase walls observed
+    phases = {s["labels"]["phase"]
+              for s in metrics.snapshot()[
+                  "raft_tpu_build_phase_seconds"]["series"]}
+    assert {"kmeans_balanced/em", "kmeans_balanced/final"} <= phases, phases
+    # the full em/final/fill decomposition through an IVF build: the same
+    # series the distributed driver emits, so dashboards compare 1:1
+    from raft_tpu.neighbors import ivf_flat
+
+    before = obs.to_json()
+    ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0,
+                                        kmeans_train_mode="minibatch",
+                                        kmeans_batch_rows=256),
+                   rng.standard_normal((1024, 8)).astype(np.float32))
+    d2 = obs.delta(before, obs.to_json())
+    got = {k.split('phase="')[1].split('"')[0]: v for k, v in d2.items()
+           if "assignment_passes" in k}
+    assert got == {"em": 20.0, "final": 1.0, "fill": 1.0}, got

@@ -612,3 +612,52 @@ def test_warm_delta_ladder_keeps_hot_path_compile_free(data, queries):
             svc.pump()
             fut.result(timeout=0)
     assert rec.compile_s == 0.0 and rec.programs == 0
+
+
+def test_rebuild_uses_injected_builder(data, queries):
+    """builder= (ISSUE 6) replaces the default module.build in REBUILD
+    compaction — the hook the sharded CAGRA rebuild rides
+    (parallel.cagra.merged_builder). For IVF kinds a builder also satisfies
+    can_rebuild without index_params."""
+    from raft_tpu.neighbors import ivf_flat
+
+    calls = []
+
+    def builder(rows, res=None):
+        calls.append(rows.shape[0])
+        return ivf_flat.build(ivf_flat.IndexParams(n_lists=4, seed=0), rows)
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=4, seed=0),
+                         jnp.asarray(data))
+    m = stream.MutableIndex(idx, search_params=ivf_flat.SearchParams(n_probes=4),
+                            delta_capacity=32, dataset=data, builder=builder)
+    assert m.can_rebuild  # builder stands in for index_params
+    gids = m.upsert(queries[0:1] + 1e-3)
+    m.delete([0, 1])
+    rep = m.compact(mode="rebuild")
+    assert rep["mode"] == "rebuild" and rep["reclaimed"] == 2
+    assert calls == [len(data) - 2 + 1]  # the live-row matrix, once
+    # the rebuilt sealed serves: parity vs ground truth over the live rows
+    live_mat = np.concatenate([data[2:], np.asarray(queries[0:1] + 1e-3)])
+    live_gids = np.concatenate([np.arange(2, len(data)), gids])
+    want = bf_gids(live_mat, live_gids, queries, 5)
+    _, got = m.search(queries, 5)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_builder_kind_mismatch_rejected(data):
+    """A builder returning a different index kind is a configuration error,
+    caught at the swap — not a silently corrupted mutable index."""
+    from raft_tpu.neighbors import ivf_flat
+
+    def wrong_builder(rows, res=None):
+        return brute_force.BruteForce().build(jnp.asarray(rows))
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=4, seed=0),
+                         jnp.asarray(data))
+    m = stream.MutableIndex(idx, search_params=ivf_flat.SearchParams(n_probes=4),
+                            delta_capacity=32, dataset=data,
+                            builder=wrong_builder)
+    m.upsert(data[:1] + 1e-3)
+    with pytest.raises(RaftError, match="builder returned"):
+        m.compact(mode="rebuild")
