@@ -59,6 +59,13 @@ archives per round:
                                  wall (churn.compaction_wall_s); the r07
                                  mini-batch coarse EM + sharded builds
                                  surface here as write throughput.
+  tune_smoke_10k                 raft_tpu.tune loop proof (ISSUE 7): a
+                                 tiny-budget autotune sweep on a 10k IVF-PQ
+                                 index — chosen vs grid-head (hand-picked)
+                                 operating point with the QPS ratio in the
+                                 row; the full sweeps write TUNE_rXX.json
+                                 via bench/tune_sweep.py. `--tune-smoke`
+                                 runs ONLY this row.
   ivf_flat_1m_p8                 IVF-Flat on the isotropic clustered 1M set
   cagra_1m_itopk32               CAGRA on the same set
 
@@ -996,6 +1003,50 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
     })
 
 
+def _row_tune_smoke(rows, n=10_000, d=64, ncl=200, n_lists=64, k=10, m=512,
+                    repeats=2):
+    """Tiny-budget autotune sweep riding the default bench (ISSUE 7): a
+    10k IVF-PQ index swept over the 3-point smoke grid through
+    raft_tpu.tune — proving the measure→choose→record loop end-to-end on
+    whatever hardware the bench runs, without wall-clock blowup. The row
+    carries the chosen operating point, the grid-head (hand-picked)
+    baseline, and their QPS ratio; by the engine's choice rule the chosen
+    point matches or beats the head at equal-or-better recall. Heavy
+    sweeps live in bench/tune_sweep.py (the TUNE_rXX.json driver)."""
+    import jax
+
+    from raft_tpu import tune
+    from raft_tpu.neighbors import ivf_pq
+
+    _note("tune: dataset")
+    dataset, qsets = _make_clustered(n, d, m, ncl, n_qsets=1, seed=19)
+    jax.block_until_ready([dataset] + qsets)
+    _note("tune: ivf_pq build")
+    t0 = time.perf_counter()
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4,
+                           pq_dim=max(min(32, d // 2), 1), seed=0), dataset)
+    jax.block_until_ready(idx.list_codes)
+    build_s = time.perf_counter() - t0
+    _note("tune: sweep")
+    dec = tune.sweep(idx, qsets[0], k=k, dataset=dataset,
+                     grid=tune.smoke_grid("ivf_pq"),
+                     recall_target="default", repeats=repeats)
+    ev = dec.evidence
+    rows.append({
+        "name": "tune_smoke_10k",
+        "qps": ev["chosen_qps"], "recall": ev["chosen_recall"],
+        "build_s": round(build_s, 1),
+        "decision": dec.key, "chosen": dict(dec.params),
+        "default": dict(ev["default_params"]),
+        "default_qps": ev["default_qps"],
+        "default_recall": ev["default_recall"],
+        "recall_target": ev["recall_target"],
+        "n_trials": len(ev["trials"]),
+        "chosen_qps_over_default": ev["chosen_qps_over_default"],
+    })
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -1223,6 +1274,10 @@ def _run(rows):
                    lambda: _row_serve_churn_cagra(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "tune_smoke_10k", lambda: _row_tune_smoke(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -1299,6 +1354,13 @@ def main(argv=None):
                        lambda: _row_serve_churn(rows))
             _row_guard(rows, "serve_churn_cagra_100k",
                        lambda: _row_serve_churn_cagra(rows))
+        elif "--tune-smoke" in argv:
+            # autotune loop proof only (ISSUE 7): the quick iteration
+            # path for the tune sweep engine; heavy sweeps are
+            # bench/tune_sweep.py
+            _setup(rows)
+            _row_guard(rows, "tune_smoke_10k",
+                       lambda: _row_tune_smoke(rows))
         elif "--serve" in argv:
             # serving-layer A/B only (ISSUE 3): the quick loop for
             # iterating on batcher/registry parameters

@@ -32,6 +32,10 @@ Subpackages (lazily imported):
   stream     mutable index lifecycle: delta memtable, tombstone
              deletes, background compaction with warm hot-swap (no ref counterpart —
                                                                 FreshDiskANN-style fresh/sealed split)
+  tune       obs-driven autotuner: sweep engine, decision log,
+             pinned operating points applied at serve.publish  (ref: the compiled-in
+                                                                select_k heuristic table,
+                                                                measured instead)
 """
 
 import importlib
@@ -59,6 +63,7 @@ _SUBMODULES = {
     "serve",
     "spatial",
     "stream",
+    "tune",
     "config",
 }
 

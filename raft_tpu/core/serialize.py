@@ -27,6 +27,9 @@ __all__ = [
     "deserialize_json",
     "serialize_header",
     "check_header",
+    "serialize_tuned",
+    "deserialize_tuned",
+    "version_number",
     "SERIALIZATION_VERSION",
 ]
 
@@ -46,21 +49,39 @@ __all__ = [
 #       index + delta memtable + tombstones in one file) and a "brute_force"
 #       section (the stream wrapper's simplest sealed kind); the
 #       ivf_flat/ivf_pq/cagra layouts are unchanged from /7.
-SERIALIZATION_VERSION = "raft_tpu/8"
+#   raft_tpu/9: every index section gains an optional trailing "tuned"
+#       record (bool has_tuned + JSON decision, raft_tpu.tune — the pinned
+#       operating point rides WITH the index, provenance inline); absent on
+#       untuned indexes, skipped cleanly by the /8 layouts.
+SERIALIZATION_VERSION = "raft_tpu/9"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
 # must not force rebuilds of unchanged formats; loaders branch on the
 # returned version where a field was added). "stream"/"brute_force" are new
-# in /8, so they have no older layouts to accept.
+# in /8, so that is the oldest layout they accept.
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                           "raft_tpu/5", "raft_tpu/6", "raft_tpu/7"}),
+                           "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
+                           "raft_tpu/8"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
-                         "raft_tpu/6", "raft_tpu/7"}),
+                         "raft_tpu/6", "raft_tpu/7", "raft_tpu/8"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
-                        "raft_tpu/5", "raft_tpu/6", "raft_tpu/7"}),
+                        "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
+                        "raft_tpu/8"}),
+    "stream": frozenset({"raft_tpu/8"}),
+    "brute_force": frozenset({"raft_tpu/8"}),
 }
+
+
+def version_number(ver: str) -> int:
+    """``"raft_tpu/9" -> 9`` — loaders use ordered comparisons for fields
+    added at version N ("present from /9 on") instead of growing excluded
+    -version tuples forever."""
+    try:
+        return int(ver.rsplit("/", 1)[1])
+    except (IndexError, ValueError):
+        raise ValueError(f"not a raft_tpu format version string: {ver!r}")
 
 
 def serialize_header(fp: BinaryIO, tag: str) -> None:
@@ -159,3 +180,26 @@ def serialize_json(fp: BinaryIO, obj: Any) -> None:
 def deserialize_json(fp: BinaryIO) -> Any:
     (n,) = struct.unpack("<i", fp.read(4))
     return json.loads(fp.read(n).decode())
+
+
+def serialize_tuned(fp: BinaryIO, tuned: dict | None) -> None:
+    """Write the optional trailing tuned record (raft_tpu/9): a presence
+    bool, then the decision JSON. One helper shared by every index writer
+    so the layout cannot drift per kind. Gated on the CURRENT format
+    version — a writer pinned to an older version (back-compat tests)
+    emits true old-layout bytes."""
+    if version_number(SERIALIZATION_VERSION) < 9:
+        return
+    serialize_scalar(fp, tuned is not None)
+    if tuned is not None:
+        serialize_json(fp, tuned)
+
+
+def deserialize_tuned(fp: BinaryIO, ver: str) -> dict | None:
+    """Read the tuned record written by :func:`serialize_tuned`; files
+    older than raft_tpu/9 have none (returns None — defaults apply)."""
+    if version_number(ver) < 9:
+        return None
+    if not deserialize_scalar(fp):
+        return None
+    return deserialize_json(fp)

@@ -24,7 +24,7 @@ from jax import lax
 from ..core.errors import expects
 from ..obs.instrument import instrument, nrows
 
-__all__ = ["select_k"]
+__all__ = ["select_k", "set_wide_cols_threshold", "wide_cols_threshold"]
 
 # Widest k the TPU streaming selector is dispatched for — MUST equal
 # ops.topk.TOPK_MAX_K (pinned by tests/test_matrix.py::test_select_k_dispatch
@@ -37,6 +37,45 @@ __all__ = ["select_k"]
 # regresses — the escape hatch the repro harness (bench/topk_chain_repro.py)
 # documents.
 SELECT_K_DISPATCH_MAX_K = 256
+
+
+# Column width above which the streaming selector wins over lax.top_k
+# (measured at r05: parity below ~64k cols, 1.3x at 100k). A parked
+# conservative guess until a TPU run moves it — which is why it is now a
+# TUNABLE: raft_tpu.tune.sweep_select_k measures the crossover and
+# tune.apply_global pins it here (or set RAFT_TPU_WIDE_SELECT_COLS).
+WIDE_SELECT_COLS_DEFAULT = 65536
+
+_wide_cols_override: int | None = None
+
+
+def set_wide_cols_threshold(n: int | None) -> None:
+    """Pin (or with None, reset) the wide-select column threshold — the
+    application point of a ``select_k`` tune decision
+    (:func:`raft_tpu.tune.apply_global`). Read at TRACE time: programs
+    already compiled for a shape keep the dispatch they traced with."""
+    global _wide_cols_override
+    expects(n is None or int(n) >= 1,
+            "wide-select threshold must be >= 1 columns, got %r", n)
+    _wide_cols_override = None if n is None else int(n)
+
+
+def wide_cols_threshold() -> int:
+    """The live wide-select column threshold: a :func:`set_wide_cols_
+    threshold` pin, else RAFT_TPU_WIDE_SELECT_COLS, else the measured
+    65536-column default."""
+    import os
+
+    if _wide_cols_override is not None:
+        return _wide_cols_override
+    env = os.environ.get("RAFT_TPU_WIDE_SELECT_COLS")
+    if not env:
+        return WIDE_SELECT_COLS_DEFAULT
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"RAFT_TPU_WIDE_SELECT_COLS must be an integer, got {env!r}")
 
 
 def _dispatch_cap() -> int:
@@ -60,10 +99,12 @@ def wide_dispatch_ok(n: int, k: int, dtype, backend: str | None = None) -> bool:
     win regime on the given backend (default: the ambient one). The single
     definition of the dispatch rule — used by :func:`select_k` and by the
     in-jit routed selects inside ivf_pq's scan (the CAGRA build chunk's
-    k=gpu_top_k+1 select reaches the kernel through this same predicate)."""
+    k=gpu_top_k+1 select reaches the kernel through this same predicate).
+    The column threshold is tunable (see :func:`wide_cols_threshold`)."""
     if backend is None:
         backend = jax.default_backend()
-    return (backend == "tpu" and n >= 65536 and 0 < k <= _dispatch_cap()
+    return (backend == "tpu" and n >= wide_cols_threshold()
+            and 0 < k <= _dispatch_cap()
             and dtype in (jnp.float32, jnp.bfloat16, jnp.float16))
 
 

@@ -305,6 +305,10 @@ class BruteForce:
         self.metric = metric
         self.metric_arg = metric_arg
         self.dataset = None
+        # pinned operating point (raft_tpu.tune decision dict; None =
+        # untuned) — brute force has no search knobs, but the record still
+        # rides save/load (raft_tpu/9) so provenance survives uniformly
+        self.tuned = None
 
     def build(self, dataset, res: Resources | None = None):
         self.dataset = jnp.asarray(dataset)
@@ -320,13 +324,15 @@ def write_index(f, index: BruteForce) -> None:
     brute-force index is the stream wrapper's simplest sealed kind, so it
     needs the same composable serialization as the ANN indexes; reference:
     brute_force::index stores dataset + metric, brute_force_types.hpp)."""
-    from ..core.serialize import serialize_header, serialize_mdspan, serialize_scalar
+    from ..core.serialize import (serialize_header, serialize_mdspan,
+                                  serialize_scalar, serialize_tuned)
 
     expects(index.dataset is not None, "index is not built")
     serialize_header(f, "brute_force")
     serialize_scalar(f, int(resolve_metric(index.metric)))
     serialize_scalar(f, float(index.metric_arg))
     serialize_mdspan(f, index.dataset)
+    serialize_tuned(f, index.tuned)
 
 
 def read_index(f) -> BruteForce:
@@ -334,13 +340,15 @@ def read_index(f) -> BruteForce:
     :func:`write_index`)."""
     import jax.numpy as jnp
 
-    from ..core.serialize import check_header, deserialize_mdspan, deserialize_scalar
+    from ..core.serialize import (check_header, deserialize_mdspan,
+                                  deserialize_scalar, deserialize_tuned)
 
-    check_header(f, "brute_force")
+    ver = check_header(f, "brute_force")
     metric = DistanceType(deserialize_scalar(f))
     metric_arg = float(deserialize_scalar(f))
     idx = BruteForce(metric=metric, metric_arg=metric_arg)
     idx.dataset = jnp.asarray(deserialize_mdspan(f))
+    idx.tuned = deserialize_tuned(f, ver)
     return idx
 
 

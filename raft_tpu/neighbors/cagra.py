@@ -40,7 +40,9 @@ from ..core.errors import expects
 from ..core.logger import logger
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
-                              serialize_header, serialize_mdspan, serialize_scalar)
+                              deserialize_tuned, serialize_header,
+                              serialize_mdspan, serialize_scalar,
+                              serialize_tuned)
 from ..distance.types import DistanceType, resolve_metric
 from ..obs import build as _build_metrics
 from ..obs import metrics as _metrics
@@ -213,6 +215,11 @@ class CagraIndex:
     # 1M). Pytree round trips (device_put, tree_map) drop it back to 0 —
     # the default pool, never an error; save/load preserves it.
     seed_pool_hint: int = 0
+    # pinned operating point (raft_tpu.tune decision dict; None = untuned):
+    # consulted by batched_searcher when no explicit params are given,
+    # persisted by save/load (raft_tpu/9). Same non-pytree contract as
+    # seed_pool_hint: tree round trips drop it back to None.
+    tuned: dict | None = None
 
     @property
     def size(self) -> int:
@@ -925,6 +932,7 @@ def write_index(f, index: CagraIndex) -> None:
     serialize_scalar(f, index.data_kind)
     serialize_mdspan(f, index.dataset)
     serialize_mdspan(f, index.graph)
+    serialize_tuned(f, index.tuned)
 
 
 def read_index(f) -> CagraIndex:
@@ -942,8 +950,11 @@ def read_index(f) -> CagraIndex:
         "raft_tpu/2", "raft_tpu/3", "raft_tpu/4", "raft_tpu/5") else "float32"
     dataset = jnp.asarray(deserialize_mdspan(f))
     graph = jnp.asarray(deserialize_mdspan(f))
+    # raft_tpu/9 appended the optional tuned record (pinned operating
+    # point); older files are untuned
+    tuned = deserialize_tuned(f, ver)
     return CagraIndex(dataset=dataset, graph=graph, metric=metric,
-                      data_kind=kind, seed_pool_hint=hint)
+                      data_kind=kind, seed_pool_hint=hint, tuned=tuned)
 
 
 def save(index: CagraIndex, path: str) -> None:
@@ -960,9 +971,16 @@ def load(path: str, res: Resources | None = None) -> CagraIndex:
 def batched_searcher(index: CagraIndex, params: SearchParams | None = None):
     """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
     the surface the serve registry warms and hot-swaps through. The serving
-    ``k`` must satisfy ``k <= itopk_size`` (search()'s own precondition)."""
+    ``k`` must satisfy ``k <= itopk_size`` (search()'s own precondition).
+    With no explicit ``params``, an attached tune decision (``index.tuned``,
+    e.g. restored by a raft_tpu/9 load) supplies the pinned operating
+    point — docs/tuning.md."""
     from ._hooks import make_hook
 
+    if params is None and index.tuned is not None:
+        from ..tune.apply import make_searcher as tuned_searcher
+
+        return tuned_searcher(index, True, degrade_without_rows=True)
     sp = params or SearchParams()
     return make_hook(lambda queries, k: search(sp, index, queries, k),
                      "cagra", index.dim, index.data_kind)

@@ -37,7 +37,9 @@ from ..core import tracing
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
-                              serialize_header, serialize_mdspan, serialize_scalar)
+                              deserialize_tuned, serialize_header,
+                              serialize_mdspan, serialize_scalar,
+                              serialize_tuned)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
@@ -111,6 +113,12 @@ class IvfFlatIndex:
     # "int8" (signed bytes as given), "uint8" (bytes stored shifted by
     # -128 into the s8 domain — queries shift the same way at search)
     data_kind: str = "float32"
+    # pinned operating point (raft_tpu.tune decision dict; None = untuned):
+    # consulted by batched_searcher when no explicit params are given,
+    # persisted by save/load (raft_tpu/9). NOT part of the pytree (same
+    # contract as cagra's seed_pool_hint): tree round trips drop it back
+    # to None — defaults, never an error.
+    tuned: dict | None = None
 
     @property
     def n_lists(self) -> int:
@@ -526,6 +534,7 @@ def write_index(f, index: IvfFlatIndex) -> None:
     serialize_mdspan(f, index.list_ids)
     serialize_mdspan(f, index.list_norms)
     serialize_mdspan(f, index.list_sizes)
+    serialize_tuned(f, index.tuned)
 
 
 def read_index(f) -> IvfFlatIndex:
@@ -548,8 +557,11 @@ def read_index(f) -> IvfFlatIndex:
     sizes = jnp.asarray(deserialize_mdspan(f))
     if kind is None:
         kind = "bfloat16" if data.dtype == jnp.bfloat16 else "float32"
+    # raft_tpu/9 appended the optional tuned record (pinned operating
+    # point); older files are untuned
+    tuned = deserialize_tuned(f, ver)
     return IvfFlatIndex(centers, data, ids, norms, sizes, metric, split_factor,
-                        kind)
+                        kind, tuned=tuned)
 
 
 def save(index: IvfFlatIndex, path: str) -> None:
@@ -566,9 +578,16 @@ def load(path: str, res: Resources | None = None) -> IvfFlatIndex:
 
 def batched_searcher(index: IvfFlatIndex, params: SearchParams | None = None):
     """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
-    the surface the serve registry warms and hot-swaps through."""
+    the surface the serve registry warms and hot-swaps through. With no
+    explicit ``params``, an attached tune decision (``index.tuned``, e.g.
+    restored by a raft_tpu/9 load) supplies the pinned operating point —
+    docs/tuning.md."""
     from ._hooks import make_hook
 
+    if params is None and index.tuned is not None:
+        from ..tune.apply import make_searcher as tuned_searcher
+
+        return tuned_searcher(index, True, degrade_without_rows=True)
     sp = params or SearchParams()
     return make_hook(lambda queries, k: search(sp, index, queries, k),
                      "ivf_flat", index.dim, index.data_kind)

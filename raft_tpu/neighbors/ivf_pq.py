@@ -42,7 +42,9 @@ from ..core.logger import logger
 from ..obs.instrument import dtype_of, instrument, nrows
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
-                              serialize_header, serialize_mdspan, serialize_scalar)
+                              deserialize_tuned, serialize_header,
+                              serialize_mdspan, serialize_scalar,
+                              serialize_tuned)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k, select_k_impl
@@ -220,6 +222,12 @@ class IvfPqIndex:
     # way; data_kind governs what extend() accepts and how search()
     # coerces queries, so a byte index never silently mixes domains.
     data_kind: str = "float32"
+    # pinned operating point (raft_tpu.tune decision dict; None = untuned):
+    # consulted by batched_searcher when no explicit params are given,
+    # persisted by save/load (raft_tpu/9). NOT part of the pytree (same
+    # contract as cagra's seed_pool_hint): tree round trips drop it back
+    # to None — defaults, never an error.
+    tuned: dict | None = None
 
     @property
     def n_lists(self) -> int:
@@ -1419,6 +1427,7 @@ def write_index(f, index: IvfPqIndex) -> None:
                 index.list_codes, index.list_ids, index.list_sizes,
                 index.list_consts, index.list_scales):
         serialize_mdspan(f, arr)
+    serialize_tuned(f, index.tuned)
 
 
 def read_index(f) -> IvfPqIndex:
@@ -1443,9 +1452,12 @@ def read_index(f) -> IvfPqIndex:
         arrs.append(jnp.asarray(deserialize_mdspan(f)))
     else:
         arrs.append(jnp.zeros((0,), jnp.float32))
+    # raft_tpu/9 appended the optional tuned record (pinned operating
+    # point); older files are untuned
+    tuned = deserialize_tuned(f, ver)
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
                       split_factor=split_factor, pq_split=pq_split,
-                      data_kind=kind)
+                      data_kind=kind, tuned=tuned)
 
 
 def save(index: IvfPqIndex, path: str) -> None:
@@ -1464,9 +1476,17 @@ def batched_searcher(index: IvfPqIndex, params: SearchParams | None = None):
     """Stable serving hook (raft_tpu.serve; contract in :mod:`._hooks`) —
     the surface the serve registry warms and hot-swaps through. For the
     candidates+refine serving pattern, publish a hook built by the caller
-    (serve accepts any callable with the hook attributes)."""
+    (serve accepts any callable with the hook attributes — or
+    ``raft_tpu.tune.make_searcher``, which wires the refine epilogue from
+    a pinned ``refine_ratio`` decision). With no explicit ``params``, an
+    attached refine-free tune decision (``index.tuned``) supplies the
+    operating point — docs/tuning.md."""
     from ._hooks import make_hook
 
+    if params is None and index.tuned is not None:
+        from ..tune.apply import make_searcher as tuned_searcher
+
+        return tuned_searcher(index, True, degrade_without_rows=True)
     sp = params or SearchParams()
     return make_hook(lambda queries, k: search(sp, index, queries, k),
                      "ivf_pq", index.dim, index.data_kind)

@@ -27,8 +27,10 @@ metric catalogue.
 
 from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
+from . import http
 from . import metrics
 from .compile import CompileRecord, attribution
+from .http import MetricsExporter, start_http_exporter, stop_http_exporter
 # NOTE: this deliberately rebinds the package attribute `obs.instrument` from
 # the submodule to the decorator (the ergonomic call site); reach the helper
 # fns via `from raft_tpu.obs.instrument import nrows`, not attribute access.
@@ -38,8 +40,9 @@ from .metrics import (DEFAULT_BUCKETS, Registry, counter, delta, disable,
                       snapshot, to_json, to_prometheus)
 
 __all__ = [
-    "metrics", "compile", "instrument", "attribution", "CompileRecord",
-    "Registry", "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
-    "snapshot", "to_prometheus", "to_json", "delta", "quantile", "reset",
-    "enable", "disable", "enabled",
+    "metrics", "compile", "http", "instrument", "attribution",
+    "CompileRecord", "MetricsExporter", "start_http_exporter",
+    "stop_http_exporter", "Registry", "DEFAULT_BUCKETS", "counter", "gauge",
+    "histogram", "snapshot", "to_prometheus", "to_json", "delta", "quantile",
+    "reset", "enable", "disable", "enabled",
 ]

@@ -1,0 +1,107 @@
+"""Opt-in stdlib HTTP exporter: the serve tier becomes scrapeable without
+a wrapper framework.
+
+``start_http_exporter(port)`` serves :func:`raft_tpu.obs.to_prometheus`
+from a daemon-threaded stdlib ``http.server`` — every GET path returns the
+text exposition format (Prometheus convention is ``/metrics``; the path is
+not enforced so a curl against ``/`` works too). Nothing starts unless the
+process asks: no port is opened at import, and the exporter holds no lock
+while rendering beyond the registry's own snapshot lock.
+
+    from raft_tpu import obs
+
+    exp = obs.start_http_exporter(9100)   # or port=0 for an ephemeral port
+    ...                                    # scrape http://host:exp.port/metrics
+    exp.stop()                             # clean shutdown (also a context
+                                           # manager; atexit not required —
+                                           # the thread is a daemon)
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics
+
+__all__ = ["MetricsExporter", "start_http_exporter", "stop_http_exporter"]
+
+# Prometheus text exposition content type (version 0.0.4 is the text format)
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_lock = threading.Lock()
+_active: "MetricsExporter | None" = None
+
+
+class MetricsExporter:
+    """One running exporter: a ThreadingHTTPServer on a daemon thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: metrics.Registry | None = None):
+        reg = registry or metrics.default_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                body = reg.to_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", _CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                # scrapes every few seconds must not spam stderr; the
+                # request count is observable from the scraper side
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self.host = host
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"raft-obs-exporter-{self.port}", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Shut the listener down and join the serving thread. Idempotent."""
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        self._thread.join(timeout_s)
+
+    def __enter__(self) -> "MetricsExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
+                        registry: metrics.Registry | None = None
+                        ) -> MetricsExporter:
+    """Start (or return the already-running) metrics HTTP endpoint.
+
+    ``port=0`` binds an ephemeral port (read it off the returned
+    ``.port``); ``host`` defaults to loopback — bind "0.0.0.0" explicitly
+    to expose beyond the machine. One exporter per process through this
+    module-level entry (a second call returns the live one); construct
+    :class:`MetricsExporter` directly for multiples or custom registries.
+    """
+    global _active
+    with _lock:
+        if _active is not None:
+            return _active
+        _active = MetricsExporter(port=port, host=host, registry=registry)
+        return _active
+
+
+def stop_http_exporter() -> None:
+    """Stop the module-level exporter (no-op when none is running)."""
+    global _active
+    with _lock:
+        exp, _active = _active, None
+    if exp is not None:
+        exp.stop()

@@ -54,10 +54,23 @@ def _queue_depth():
 
 
 @functools.lru_cache(maxsize=None)
-def _wait_seconds():
+def _queue_wait_seconds():
+    # paired with _flush_seconds below so a request's p99 decomposes into
+    # queue wait vs device/compute — the attribution the autotuner
+    # (raft_tpu.tune) and SLO debugging both read (ISSUE 7 satellite)
     return metrics.histogram(
-        "raft_tpu_serve_wait_seconds",
-        "per-request queue wait from submit to batch drain", unit="seconds")
+        "raft_tpu_serve_queue_wait_seconds",
+        "per-request queue wait from admission (submit) to flush pickup — "
+        "the queue share of request latency, device time excluded",
+        unit="seconds")
+
+
+@functools.lru_cache(maxsize=None)
+def _flush_seconds():
+    return metrics.histogram(
+        "raft_tpu_serve_flush_seconds",
+        "flush_fn wall per flush (search + materialize) — the "
+        "device/compute share of request latency", unit="seconds")
 
 
 @functools.lru_cache(maxsize=None)
@@ -297,8 +310,12 @@ class MicroBatcher:
         n_valid = drained.rows
         bucket = bucket_for(n_valid, self.max_batch)
         if metrics._enabled:
+            # `now` is the drain/pickup instant: submit -> here is pure
+            # queueing; the flush_fn wall below is pure compute, so the
+            # two histograms decompose the request's latency
             for r in batch:
-                _wait_seconds().observe(now - r.enqueued, stream=self.stream)
+                _queue_wait_seconds().observe(now - r.enqueued,
+                                              stream=self.stream)
             _occupancy().observe(n_valid / bucket, stream=self.stream)
             _flush_total().inc(1, stream=self.stream, bucket=bucket)
         try:
@@ -311,7 +328,11 @@ class MicroBatcher:
                 pad = np.zeros((bucket - n_valid,) + q.shape[1:], q.dtype)
                 q = np.concatenate([q, pad])
             with tracing.range("serve/flush/%d", bucket):
+                t_flush = self._clock()
                 out = tuple(np.asarray(a) for a in self._flush_fn(q))
+                if metrics._enabled:
+                    _flush_seconds().observe(self._clock() - t_flush,
+                                             stream=self.stream)
         except Exception as e:
             _error_total().inc(1, stream=self.stream)
             for r in batch:

@@ -126,7 +126,7 @@ class IndexRegistry:
     # -- publish / swap -----------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True, warm_data=None) -> dict:
+                warm: bool = True, warm_data=None, tuned=None) -> dict:
         """Make ``(index, search_params)`` the active version of ``name``.
 
         Warms the searcher at every registry bucket shape for every ``k``
@@ -142,9 +142,32 @@ class IndexRegistry:
         program coverage (compilation is shape-keyed), representative
         warm-time walls in the report (:func:`raft_tpu._warmup
         .warm_buckets`).
+
+        ``tuned`` (a :class:`raft_tpu.tune.DecisionLog`, a single
+        :class:`~raft_tpu.tune.Decision`/dict, or ``True`` to use the
+        decision attached to the index) serves the index at its pinned
+        operating point: the searcher is built through
+        :func:`raft_tpu.tune.make_searcher`, and the warm ladder below
+        covers the TUNED programs — applying a decision never introduces
+        a cold compile on the hot path (docs/tuning.md; the report's
+        per-bucket attribution proves it per publish). Mutually exclusive
+        with ``search_params`` and pre-built hooks; ``refine_ratio``
+        operating points need the raw rows, so publish the hook
+        ``tune.make_searcher(index, log, dataset=rows)`` builds instead.
         """
         from .._warmup import warm_buckets
 
+        if tuned is not None:
+            from ..tune.apply import make_searcher as tuned_searcher
+
+            expects(search_params is None,
+                    "tuned= and search_params= both pin search params — "
+                    "pass one")
+            expects(not (callable(index) and hasattr(index, "kind"))
+                    and not hasattr(index, "upsert"),
+                    "tuned= applies to a plain index; pre-built hooks and "
+                    "stream.MutableIndex bake their own params")
+            index = tuned_searcher(index, tuned)
         if callable(index) and hasattr(index, "kind"):
             # pre-built hook: its params are baked into the closure, so a
             # search_params here would be silently ignored — refuse instead
@@ -180,7 +203,11 @@ class IndexRegistry:
                         "publish(%r): live widths %s must be kept (got %s) "
                         "— dropping a width orphans its live stream",
                         name, prev.ks, tuple(ks))
-            report: dict = {"name": name, "warmed": warm, "warm": {}}
+            report: dict = {"name": name, "warmed": warm, "warm": {},
+                            # decision key when the hook runs a tune pin
+                            # (set by tune.make_searcher) — the publish
+                            # report says which operating point went live
+                            "tuned": getattr(searcher, "tuned", None)}
             if warm:
                 for kk in ks:
                     report["warm"][int(kk)] = warm_buckets(

@@ -136,13 +136,17 @@ class SearchService:
     # -- publish ------------------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True, warm_data=None) -> dict:
+                warm: bool = True, warm_data=None, tuned=None) -> dict:
         """Publish/hot-swap through the service's registry, warming against
         the SERVICE's bucket ladder (the shapes its streams actually flush).
         Safe under load: in-flight requests finish on the old version.
         ``warm_data`` (optional (rows, dim) sample in the serving dtype)
         draws the warmup queries from real data — see
-        :func:`raft_tpu._warmup.warm_buckets`.
+        :func:`raft_tpu._warmup.warm_buckets`. ``tuned`` (a
+        :class:`raft_tpu.tune.DecisionLog` / ``Decision`` / ``True``)
+        serves the index at its pinned operating point; the warm ladder
+        covers the tuned programs, so applying a decision is as hiccup-free
+        as any other publish (docs/tuning.md).
 
         Publishing a :class:`raft_tpu.stream.MutableIndex` additionally
         opens the WRITE path: :meth:`upsert`/:meth:`delete` on this name
@@ -156,7 +160,8 @@ class SearchService:
             with self.registry.publish_lock(name):
                 report = self.registry.publish(
                     name, index, search_params=search_params, k=k,
-                    version=version, warm=warm, warm_data=warm_data)
+                    version=version, warm=warm, warm_data=warm_data,
+                    tuned=tuned)
                 with self._lock:
                     mut = getattr(index, "mutable", None)
                     if hasattr(index, "upsert") and hasattr(index, "searcher"):

@@ -1,0 +1,54 @@
+"""raft_tpu.tune — the obs-driven autotuner: measure, calibrate, pin.
+
+The reference compiles its dispatch heuristics in as constants (the
+select_radix vs warpsort cutoff table, ``detail/select_k-inl.cuh:46``;
+fixed ``n_probes`` defaults) and this repo accumulated the same debt as
+parked conservative guesses: the wide-k select 65536-column threshold, the
+CAGRA build-chunk select A/B "waiting on a TPU run", the hop-merge impl
+choice, and per-dataset-family ``probes/itopk/refine_ratio`` — which
+BASELINE round 5 proved do NOT transfer across families (heavytail 0.31 vs
+0.82 recall at the same operating point).
+
+This package closes those decisions the ANN-Benchmarks way (Aumüller et
+al., 2017): an operating point is only meaningful as a measured point on a
+recall-vs-QPS frontier, so every choice here is a recorded measurement —
+the Google-Wide-Profiling pattern (Ren et al., IEEE Micro 2010) of
+always-on observation feeding optimization decisions, applied at library
+scale. Measure (``sweep`` drives the search pipeline over a param grid,
+emitting ``raft_tpu_tune_*`` obs events per trial), calibrate (the chosen
+point is the QPS argmax meeting the recall target, with the full trial
+evidence kept inline), pin (the :class:`DecisionLog` persists per
+``(index kind, dtype, shape family)`` — in a JSON artifact, and in the
+index file itself via the raft_tpu/9 ``tuned`` section) — with a drift
+test re-measuring the committed artifact, exactly as the calibrated
+seed-pool estimator did (BASELINE round 5).
+
+Surface:
+
+- :mod:`.decisions` — :class:`Decision` / :class:`DecisionLog`,
+  :func:`shape_family` / :func:`family_of` (the keying rule).
+- :mod:`.sweep` — :func:`sweep` (recall-vs-QPS trials over one index),
+  :func:`sweep_select_k` (the select-impl × column-width prim sweep).
+- :mod:`.apply` — :func:`tuned_search_params` / :func:`make_searcher`
+  (decision → SearchParams / serving hook), :func:`attach` (pin onto an
+  index, persisted by save/load), :func:`apply_global` (process-wide
+  dispatch thresholds, e.g. the wide-select column cutoff).
+
+``serve.publish(name, index, tuned=log)`` applies a decision at publish
+time alongside ``warm_data=``; the registry's warm ladder then covers the
+tuned programs, so applying a decision never introduces a cold compile on
+the hot path (asserted via obs compile attribution). See docs/tuning.md.
+"""
+
+from . import reference
+from .apply import (apply_global, attach, make_searcher, resolve,
+                    tuned_search_params)
+from .decisions import Decision, DecisionLog, family_of, kind_of, shape_family
+from .sweep import Trial, default_grid, smoke_grid, sweep, sweep_select_k
+
+__all__ = [
+    "Decision", "DecisionLog", "shape_family", "family_of", "kind_of",
+    "Trial", "sweep", "sweep_select_k", "default_grid", "smoke_grid",
+    "tuned_search_params", "make_searcher", "attach", "resolve",
+    "apply_global", "reference",
+]

@@ -1,0 +1,151 @@
+"""The committed reference sweep: deterministic families, reproducible pins.
+
+``cpu_mesh_decisions()`` rebuilds a fixed set of dataset families on the
+8-device virtual CPU mesh (the tests' hardware), sweeps each through
+:func:`~.sweep.sweep`, and returns the :class:`~.decisions.DecisionLog`
+that is committed at the repo root as ``TUNE_rXX.json`` (``bench/
+tune_sweep.py --cpu-mesh`` writes it; r08 is the first). Everything here
+is seeded jax.random on CPU, so recall numbers are bit-stable across runs
+of the same code — which is what lets ``tests/test_tune.py`` drift-pin the
+artifact: rebuild a family, re-measure the chosen and default operating
+points, and fail if the measured recall moved past tolerance. QPS is NOT
+pinned (wall clock on a shared CPU is noise); the choice rule's guarantee
+— chosen matches-or-beats the grid-head hand-picked point at
+equal-or-better recall — is asserted from the artifact's own numbers.
+
+Families (scaled for CI wall clock; the TPU driver runs the same shapes
+at bench scale):
+
+- ``ivf_flat_bal`` / ``ivf_pq_bal`` — isotropic clustered rows, the bench
+  harness's distribution (gaussian blobs, full-dimensional residuals).
+- ``ivf_pq_skew`` — Zipf-populated clusters (the heavytail signature from
+  BASELINE round 5, where operating points measurably did not transfer):
+  keyed to a DIFFERENT family by the list-size CV classifier, so its pin
+  never leaks onto balanced data.
+- ``cagra_bal`` — the graph index on the isotropic set.
+- ``select_k`` — the wide-select column-threshold prim sweep (on CPU the
+  Pallas arm records "ineligible"; the TPU run replaces the entry).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..core.errors import expects
+from .decisions import DecisionLog
+from .sweep import default_grid, sweep, sweep_select_k
+
+__all__ = ["FAMILY_NAMES", "build_family", "run_family",
+           "cpu_mesh_decisions", "ROUND"]
+
+ROUND = "r08"
+
+FAMILY_NAMES = ("ivf_flat_bal", "ivf_pq_bal", "ivf_pq_skew", "cagra_bal",
+                "select_k")
+
+# one shared small-scale config so the drift test and the artifact
+# generator cannot diverge
+_SCALE = {
+    "ivf": dict(n=12_000, d=64, ncl=256, n_lists=64, m=512, k=10),
+    "cagra": dict(n=4_096, d=48, ncl=64, m=256, k=10),
+}
+
+
+def _clustered(n, d, m, ncl, seed, heavytail=False):
+    """Gaussian-blob rows + queries (the bench generator's distribution).
+    ``heavytail`` draws per-cluster residual SCALES from a lognormal
+    (sigma 1.0) — the BASELINE round-5 family whose operating points did
+    not transfer (one global quantizer spans orders of magnitude of
+    residual norm); detected by the tune scale-skew classifier."""
+    import jax
+    import jax.numpy as jnp
+
+    kc, ks, kl, kn, kql, kqn = jax.random.split(jax.random.key(seed), 6)
+    centers = jax.random.uniform(kc, (ncl, d), jnp.float32) * 10.0
+    scales = (0.5 * jnp.exp(jax.random.normal(ks, (ncl,)))
+              if heavytail else 0.5 * jnp.ones((ncl,), jnp.float32))
+    labels = jax.random.randint(kl, (n,), 0, ncl)
+    qlabels = jax.random.randint(kql, (m,), 0, ncl)
+    x = centers[labels] + scales[labels, None] * jax.random.normal(kn, (n, d))
+    q = (centers[qlabels]
+         + scales[qlabels, None] * jax.random.normal(kqn, (m, d)))
+    jax.block_until_ready((x, q))
+    return x, q
+
+
+@functools.lru_cache(maxsize=None)
+def _ivf_dataset(skew: bool):
+    c = _SCALE["ivf"]
+    return _clustered(c["n"], c["d"], c["m"], c["ncl"],
+                      seed=29 if skew else 23, heavytail=skew)
+
+
+def build_family(name: str) -> dict:
+    """Build one reference family: returns ``{index, queries, dataset,
+    grid, k, sweep_kwargs}`` — the exact inputs :func:`run_family` sweeps,
+    exposed so the drift test can re-measure single operating points
+    without paying a full sweep."""
+    expects(name in FAMILY_NAMES, "unknown reference family %r (one of %s)",
+            name, ", ".join(FAMILY_NAMES))
+    if name == "select_k":
+        return {"sweep_kwargs": dict(rows=64, cols=(32768, 65536),
+                                     ks=(10, 128))}
+    if name == "cagra_bal":
+        from ..neighbors import cagra
+
+        c = _SCALE["cagra"]
+        x, q = _clustered(c["n"], c["d"], c["m"], c["ncl"], seed=31)
+        idx = cagra.build(cagra.IndexParams(seed=0), x)
+        grid = [{"itopk_size": 32}, {"itopk_size": 16}, {"itopk_size": 64}]
+        return {"index": idx, "queries": q, "dataset": x, "grid": grid,
+                "k": c["k"]}
+    c = _SCALE["ivf"]
+    skew = name.endswith("_skew")
+    x, q = _ivf_dataset(skew)
+    if name.startswith("ivf_flat"):
+        from ..neighbors import ivf_flat
+
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=c["n_lists"], seed=0), x)
+        grid = default_grid("ivf_flat")
+    else:
+        from ..neighbors import ivf_pq
+
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=c["n_lists"], pq_bits=4,
+                               pq_dim=c["d"] // 2, seed=0), x)
+        grid = default_grid("ivf_pq")
+    return {"index": idx, "queries": q, "dataset": x, "grid": grid,
+            "k": c["k"]}
+
+
+def run_family(name: str, log: DecisionLog | None = None,
+               repeats: int = 2):
+    """Sweep one reference family into ``log`` (created if None); returns
+    the Decision."""
+    fam = build_family(name)
+    if name == "select_k":
+        return sweep_select_k(log=log, repeats=repeats,
+                              **fam["sweep_kwargs"])
+    return sweep(fam["index"], fam["queries"], k=fam["k"],
+                 dataset=fam["dataset"], grid=fam["grid"],
+                 recall_target="default", repeats=repeats, log=log)
+
+
+def cpu_mesh_decisions(names=FAMILY_NAMES, repeats: int = 2) -> DecisionLog:
+    """Run every reference family; returns the artifact-ready log."""
+    import jax
+
+    log = DecisionLog(meta={
+        "round": ROUND,
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "scale": {k: dict(v) for k, v in _SCALE.items()},
+        "note": "CPU-mesh reference sweep (bench/tune_sweep.py --cpu-mesh);"
+                " recall values are drift-pinned by tests/test_tune.py, QPS"
+                " is environment-local. The TPU driver overwrites entries"
+                " at bench scale.",
+    })
+    for name in names:
+        run_family(name, log=log, repeats=repeats)
+    return log

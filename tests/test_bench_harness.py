@@ -380,3 +380,46 @@ def test_build_ab_table_renders_from_artifact():
         assert needle in table, (needle, table)
     # a markdown table: header + separator + one line per arm
     assert table.count("|") > 30
+
+
+def test_tune_smoke_row():
+    """The --tune-smoke bench row (ISSUE 7): a tiny-budget sweep must
+    produce a full row — chosen vs grid-head operating point with the QPS
+    ratio — and the engine's choice rule makes chosen match-or-beat the
+    head at equal-or-better recall by construction."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_tune_smoke(rows, n=2000, d=16, ncl=32, n_lists=16, k=5,
+                          m=64, repeats=1)
+    row = rows[-1]
+    assert row["name"] == "tune_smoke_10k" and "error" not in row, rows
+    assert row["n_trials"] == 3, row
+    assert row["decision"].startswith("ivf_pq/float32/"), row
+    assert row["qps"] >= row["default_qps"], row
+    assert row["recall"] >= row["recall_target"], row
+    assert row["chosen_qps_over_default"] >= 1.0, row
+
+
+def test_tune_smoke_flag_runs_only_the_tune_row(monkeypatch):
+    """`bench.py --tune-smoke` is the autotune iteration loop: setup + the
+    tune row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_tune_smoke",
+        lambda rows: rows.append({"name": "tune_smoke_10k", "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--tune-smoke"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "tune_smoke_10k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()

@@ -558,3 +558,47 @@ def test_build_metrics_coarse_trainer():
     got = {k.split('phase="')[1].split('"')[0]: v for k, v in d2.items()
            if "assignment_passes" in k}
     assert got == {"em": 20.0, "final": 1.0, "fill": 1.0}, got
+
+
+# ---------------------------------------------------------------------------
+# HTTP exporter (ISSUE 7 satellite): scrapeable without a wrapper
+# ---------------------------------------------------------------------------
+
+
+class TestHttpExporter:
+    def test_serves_prometheus_text_and_stops_cleanly(self):
+        import urllib.error
+        import urllib.request
+
+        obs.counter("raft_tpu_items_total", "rows").inc(1, op="exporter")
+        exp = obs.start_http_exporter(0)  # ephemeral loopback port
+        try:
+            assert exp.port > 0
+            # a second start returns the live exporter, not a second port
+            assert obs.start_http_exporter(0) is exp
+            url = f"http://127.0.0.1:{exp.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                body = resp.read().decode()
+            assert 'raft_tpu_items_total{op="exporter"}' in body
+            assert "# TYPE raft_tpu_items_total counter" in body
+        finally:
+            obs.stop_http_exporter()
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/metrics", timeout=1)
+        obs.stop_http_exporter()  # idempotent
+
+    def test_custom_registry_and_context_manager(self):
+        import urllib.request
+
+        reg = obs.Registry()
+        reg.gauge("raft_tpu_serve_queue_depth", "rows").set(7, stream="s")
+        with obs.MetricsExporter(port=0, registry=reg) as exp:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/", timeout=5).read().decode()
+        assert 'raft_tpu_serve_queue_depth{stream="s"} 7' in body
+        # the default registry's series must NOT leak into a custom one
+        assert "raft_tpu_compile" not in body

@@ -1,0 +1,65 @@
+"""Metrics-catalogue drift lint (tier-1, ISSUE 7 satellite).
+
+Every ``raft_tpu_*`` metric registered anywhere in the source tree must
+appear in docs/observability.md's catalogue table, and every catalogued
+name must still be registered in source — both directions, so the
+catalogue can no longer silently rot (new metrics shipping undocumented,
+or doc rows surviving their metric's removal).
+
+The source side is a static scan for the registration idiom
+(``counter("raft_tpu_...")`` / ``gauge(...)`` / ``histogram(...)`` with a
+literal first argument) — the registry offers no other way to create a
+metric, and a dynamically-composed name would defeat grepability on
+purpose, so the lint also enforces the literal-name convention.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+DOC = REPO / "docs" / "observability.md"
+
+# registration call with a literal raft_tpu_* name (possibly wrapped to
+# the next line); \s* spans newlines
+_REGISTRATION = re.compile(
+    r'\b(?:counter|gauge|histogram)\(\s*"(raft_tpu_[a-z0-9_]+)"')
+# a catalogue row: "| `raft_tpu_...` | type | ..."
+_DOC_ROW = re.compile(r"^\|\s*`(raft_tpu_[a-z0-9_]+)`\s*\|", re.M)
+
+
+def _source_metrics() -> set:
+    names = set()
+    for path in sorted((REPO / "raft_tpu").rglob("*.py")):
+        names.update(_REGISTRATION.findall(path.read_text()))
+    return names
+
+
+def _documented_metrics() -> set:
+    return set(_DOC_ROW.findall(DOC.read_text()))
+
+
+def test_every_registered_metric_is_documented():
+    undocumented = _source_metrics() - _documented_metrics()
+    assert not undocumented, (
+        "metrics registered in source but missing from the "
+        f"docs/observability.md catalogue table: {sorted(undocumented)}")
+
+
+def test_every_documented_metric_is_registered():
+    stale = _documented_metrics() - _source_metrics()
+    assert not stale, (
+        "docs/observability.md catalogues metrics no source file "
+        f"registers: {sorted(stale)}")
+
+
+def test_scan_is_not_vacuous():
+    """The lint must actually see both sides (a regex gone stale would
+    pass the two set assertions with empty sets)."""
+    src, doc = _source_metrics(), _documented_metrics()
+    assert len(src) >= 30, sorted(src)
+    assert len(doc) >= 30, sorted(doc)
+    # spot-check well-known names from three subsystems
+    for name in ("raft_tpu_serve_queue_wait_seconds",
+                 "raft_tpu_tune_trials_total",
+                 "raft_tpu_compile_cache_total"):
+        assert name in src and name in doc, name

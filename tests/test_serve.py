@@ -387,13 +387,38 @@ def test_metrics_catalogue(bf, dataset):
     js = obs.to_json()
     for needed in (
             'raft_tpu_serve_queue_depth{stream="main.k5"}',
-            'raft_tpu_serve_wait_seconds_count{stream="main.k5"}',
+            'raft_tpu_serve_queue_wait_seconds_count{stream="main.k5"}',
+            'raft_tpu_serve_flush_seconds_count{stream="main.k5"}',
             'raft_tpu_serve_batch_occupancy_count{stream="main.k5"}',
             'raft_tpu_serve_flush_total{bucket="1",stream="main.k5"}',
             'raft_tpu_serve_overload_total{name="main"}',
             'raft_tpu_serve_requests_total{stream="main.k5"}',
             'raft_tpu_serve_versions_live{name="main"}'):
         assert needed in js, f"missing {needed}"
+    svc.shutdown(drain=True)
+
+
+def test_queue_wait_vs_flush_decomposition(bf, dataset):
+    """The two latency histograms split a request's life at flush pickup:
+    queue wait is clock time from submit to pickup, flush time is the
+    flush_fn wall — both in the INJECTED clock's domain, so the split is
+    assertable exactly (ISSUE 7 satellite)."""
+    from raft_tpu import obs
+
+    clock = FakeClock()
+    svc = det_service(bf, clock, max_batch=4)
+    before = obs.to_json()
+    svc.submit("main", dataset[:1], 5)
+    clock.advance(0.25)  # the request waits 0.25 clock-seconds
+    svc.pump()
+    d = obs.delta(before, obs.to_json())
+    wait = d.get('raft_tpu_serve_queue_wait_seconds_sum'
+                 '{stream="main.k5"}', 0.0)
+    assert wait == pytest.approx(0.25)
+    # flush ran entirely between two reads of a frozen clock: 0 observed,
+    # count 1 — the histogram exists and attributes no queue time
+    assert d.get('raft_tpu_serve_flush_seconds_count'
+                 '{stream="main.k5"}') == 1
     svc.shutdown(drain=True)
 
 
@@ -417,6 +442,51 @@ def test_external_registry_must_cover_service_buckets(bf):
     with pytest.raises(RaftError):
         SearchService(reg, max_batch=8)  # ladder up to 8 not covered
     SearchService(reg, max_batch=4).shutdown()  # exact cover is fine
+
+
+def test_publish_tuned_zero_cold_compile(dataset):
+    """ISSUE 7 acceptance: publishing with a tune decision log serves the
+    pinned operating point AND the warm ladder covers the tuned programs —
+    the post-publish hot path runs compile-free, proven by obs compile
+    attribution (the same proof bench.py --serve asserts for swaps)."""
+    from raft_tpu import tune
+    from raft_tpu.obs import compile as obs_compile
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), dataset)
+    log = tune.DecisionLog()
+    log.add(tune.Decision(kind="ivf_flat", dtype="float32",
+                          family=tune.family_of(idx),
+                          params={"n_probes": 4}))
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, clock=clock, start_workers=False)
+    rep = svc.publish("tuned", idx, k=5, tuned=log)
+    assert rep["tuned"] == log.entries()[0].key
+    with obs_compile.attribution() as rec:
+        for rows in (1, 3, 4):
+            futs = [svc.submit("tuned", dataset[j:j + 1], 5)
+                    for j in range(rows)]
+            clock.advance(1.0)
+            svc.pump()
+            for f in futs:
+                d, i = f.result(timeout=5)
+                assert i.shape == (1, 5)
+    assert rec.compile_s == 0.0 and rec.cache_misses == 0
+    svc.shutdown(drain=True)
+
+
+def test_publish_tuned_excludes_search_params_and_hooks(bf, dataset):
+    from raft_tpu import tune
+
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, seed=0), dataset)
+    dec = tune.Decision(kind="ivf_flat", dtype="float32",
+                        family=tune.family_of(idx), params={"n_probes": 4})
+    reg = IndexRegistry(buckets=(1, 2))
+    with pytest.raises(RaftError, match="pass one"):
+        reg.publish("x", idx, tuned=dec,
+                    search_params=ivf_flat.SearchParams(n_probes=8))
+    hook = ivf_flat.batched_searcher(idx)
+    with pytest.raises(RaftError, match="plain index"):
+        reg.publish("x", hook, tuned=dec)
 
 
 def test_publish_hook_with_search_params_refused(bf):
