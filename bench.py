@@ -59,6 +59,20 @@ archives per round:
                                  wall (churn.compaction_wall_s); the r07
                                  mini-batch coarse EM + sharded builds
                                  surface here as write throughput.
+  canary_smoke_100k              raft_tpu.obs.quality overhead A/B
+                                 (ISSUE 8): closed-loop served QPS with
+                                 canary sampling at 0% vs 1% vs 5% (the
+                                 background drainer shadow-reranking
+                                 against the exact live-corpus kNN), the
+                                 streaming recall estimate + Wilson
+                                 interval bracketing the offline truth
+                                 (canary.oracle_in_interval), and the
+                                 compile-free hot path with monitoring ON
+                                 (compile_s == 0). `--canary-smoke` runs
+                                 ONLY this row. The churn rows above also
+                                 carry a "canary" field: the estimate
+                                 measured UNDER churn with compaction
+                                 swaps, bracketed against recall_mut.
   tune_smoke_10k                 raft_tpu.tune loop proof (ISSUE 7): a
                                  tiny-budget autotune sweep on a 10k IVF-PQ
                                  index — chosen vs grid-head (hand-picked)
@@ -776,7 +790,10 @@ def _row_serve_churn(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
         materialize=lambda idx: idx.list_codes,
         search_params=sp,
         oracle_search=lambda idx, q, kk: ivf_pq.search(sp, idx, q, kk),
-        mutable_kwargs=dict(retain_vectors=False),
+        # the live recall canary rides this row (ISSUE 8): the mutable
+        # retains the raw rows so the canary's exact shadow oracle covers
+        # sealed + delta with tombstones applied
+        mutable_kwargs=dict(retain_vectors=False), canary_rate=0.05,
         n=n, d=d, k=k, threads=threads, writer_steps=writer_steps,
         upserts_per_step=upserts_per_step, deletes_per_step=deletes_per_step,
         delta_capacity=delta_capacity, compact_fill=compact_fill,
@@ -822,13 +839,25 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
                       oracle_search, mutable_kwargs, n, d, k, threads,
                       writer_steps, upserts_per_step, deletes_per_step,
                       delta_capacity, compact_fill, max_batch, max_wait_us,
-                      ncl, n_eval):
+                      ncl, n_eval, canary_rate=0.0):
     """The shared churn protocol (see _row_serve_churn's docstring for the
     claims): dataset + sealed build, rehearsal (compiles every compaction
     epoch's program set), the attributed live window, then the fresh-oracle
     recall snapshot. ``build``/``oracle_search`` close over the index
     module's params so the IVF-PQ and CAGRA rows differ only in the sealed
-    kind and therefore in the fold mode (extend vs rebuild)."""
+    kind and therefore in the fold mode (extend vs rebuild).
+
+    ``canary_rate > 0`` additionally rides the live recall canary
+    (ISSUE 8): the mutable retains its raw rows, a RecallCanary samples
+    that fraction of served queries at the flush path and shadow-reranks
+    them against the exact live-corpus kNN at every write step; the row
+    then carries the streaming estimate + Wilson interval and whether the
+    fresh-oracle offline recall (recall_mut) fell inside it. The canary
+    runs INSIDE the attributed window, so churn.compile_s == 0 also proves
+    the canary added zero cold compiles on or off the hot path — its
+    per-epoch exact programs are covered by the rehearsal (which warms the
+    rehearsal canary after every fold of the same deterministic
+    schedule)."""
     import threading
 
     import jax
@@ -837,6 +866,7 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
     from raft_tpu import stream
     from raft_tpu.neighbors.brute_force import knn
     from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import quality
     from raft_tpu.serve import IndexRegistry, SearchService
 
     total_upserts = writer_steps * upserts_per_step
@@ -861,8 +891,15 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
 
     policy = stream.CompactionPolicy(delta_fill=compact_fill,
                                      tombstone_ratio=None, max_age_s=None)
+    mk = dict(mutable_kwargs)
+    if canary_rate > 0:
+        # the canary's exact oracle needs the raw live rows (PQ codes
+        # cannot reconstruct them); CAGRA/brute-force recover them from
+        # the sealed dataset already
+        mk.pop("retain_vectors", None)
+        mk.setdefault("dataset", x_host)
 
-    def write_schedule(mutable, comp, on_step=None):
+    def write_schedule(mutable, comp, on_step=None, after_compact=None):
         """The deterministic churn schedule — run once as the rehearsal and
         once for real. Returns (#compactions, list of compaction reports)."""
         reports = []
@@ -874,6 +911,8 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
             mutable.delete(np.arange(dlo, dlo + deletes_per_step))
             while comp.due():
                 reports.append(comp.run_once())
+                if after_compact is not None:
+                    after_compact()
             if on_step is not None:
                 on_step(step, len(reports))
         return reports
@@ -884,24 +923,43 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
 
     m0 = stream.MutableIndex(idx, search_params=sp,
                              delta_capacity=delta_capacity, name="rehearsal",
-                             **mutable_kwargs)
+                             **mk)
     reg0 = IndexRegistry(buckets=bucket_sizes(max_batch))
     reg0.publish("churn-rehearsal", m0, k=k)
     m0.warm(reg0.buckets, ks=(k,))
+    canary0 = after_compact0 = None
+    if canary_rate > 0:
+        # the rehearsal canary never samples — it exists to compile the
+        # exact-oracle program of EVERY epoch's sealed-store shape (the
+        # schedule is deterministic, so the live window replays them)
+        canary0 = quality.RecallCanary(
+            quality.exact_oracle(m0), k=k, sample_rate=0.0,
+            buckets=bucket_sizes(max_batch), name="churn-rehearsal")
+        canary0.warm()
+        after_compact0 = canary0.warm
     comp0 = stream.Compactor(m0, publisher=reg0, name="churn-rehearsal",
                              ks=(k,), policy=policy)
-    rehearsal_reports = write_schedule(m0, comp0)
-    del m0, comp0, reg0
+    rehearsal_reports = write_schedule(m0, comp0,
+                                       after_compact=after_compact0)
+    del m0, comp0, reg0, canary0
 
     # ---- the real, attributed window -------------------------------------
     _note(f"{note}: live window, {threads} reader threads")
     m = stream.MutableIndex(idx, search_params=sp,
                             delta_capacity=delta_capacity, name=note,
-                            **mutable_kwargs)
+                            **mk)
+    canary = None
+    if canary_rate > 0:
+        canary = quality.RecallCanary(
+            quality.exact_oracle(m), k=k, sample_rate=canary_rate,
+            reservoir=1024, buckets=bucket_sizes(max_batch), name="churn")
     svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
-                        max_queue_rows=max(4 * max_batch * threads, 256))
+                        max_queue_rows=max(4 * max_batch * threads, 256),
+                        canary=canary)
     svc.publish("churn", m, k=k)
     m.warm(svc.buckets, ks=(k,))
+    if canary is not None:
+        canary.warm()  # epoch-0 programs (cache-hot from the rehearsal)
     comp = stream.Compactor(m, publisher=svc, name="churn", ks=(k,),
                             policy=policy)
 
@@ -928,6 +986,11 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
             served[0] += len(my_lats)
 
     def on_step(step, n_compactions):
+        # the canary's shadow rerank runs every step, off the reader hot
+        # path, on the writer's cadence (deterministic drains; zero cold
+        # compiles — the rehearsal covered every epoch's oracle program)
+        if canary is not None:
+            canary.drain()
         # mid-churn recall snapshot: right after the schedule's midpoint
         # (past the first compaction), query the service at warmed bucket
         # shapes and record the exact live-set bookkeeping for the oracle
@@ -953,6 +1016,8 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
         done.set()
         for w in workers:
             w.join(600)
+        if canary is not None:
+            canary.drain()  # flush the tail samples inside the window
         load_s = time.perf_counter() - t_load
     svc.shutdown()
 
@@ -973,6 +1038,19 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
     recall_oracle = _recall(oracle_gids, gt_gids)
 
     lats_ms = np.sort(np.array(lats if lats else [0.0])) * 1e3
+    canary_field = None
+    if canary is not None:
+        est = canary.estimate()
+        canary_field = {
+            "rate": canary_rate,
+            "recall": round(est["recall"], 4),
+            "wilson_low": round(est["wilson_low"], 4),
+            "wilson_high": round(est["wilson_high"], 4),
+            "reranked": est["reranked"], "seen": est["seen"],
+            # the acceptance check: the fresh-oracle offline measurement
+            # (recall_mut below) inside the canary's live Wilson interval
+            "oracle_in_interval": bool(canary.in_interval(recall_mut)),
+        }
     rows.append({
         "name": name,
         "qps": round(served[0] / load_s, 1),
@@ -986,6 +1064,7 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
         "build_s": round(build_s, 1),
         "threads": threads, "max_batch": max_batch,
         "delta_capacity": delta_capacity,
+        "canary": canary_field,
         "churn": {
             "failed": len(failures),
             "compactions": len(reports),
@@ -999,6 +1078,132 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
             "compile_s": round(rec.compile_s, 3),
             "cache_misses": rec.cache_misses,
         },
+        "failures": failures[:5],
+    })
+
+
+def _row_canary_smoke(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
+                      n_probes=8, threads=8, per_thread=150,
+                      rates=(0.0, 0.01, 0.05), max_batch=64,
+                      max_wait_us=2000.0, ncl=2000, n_eval=512):
+    """Canary overhead A/B (ISSUE 8): the same closed-loop served load at
+    canary sampling 0% vs 1% vs 5%, with the background drainer running its
+    exact shadow reranks concurrently — the row measures what live quality
+    monitoring actually costs the serving path. Three claims ride in it:
+
+    - ``qps_by_rate`` / ``slowdown_at_5pct``: sampling is a host-side
+      reservoir tap and the rerank is off the hot path, so the cost should
+      be device contention only (a few percent at 5%);
+    - the **hot path stays compile-free with the canary on**: the whole
+      loaded window (all three rates, drains included) runs under obs
+      compile attribution and must report ``compile_s == 0`` — the canary
+      was warmed at every rerank bucket beforehand;
+    - the canary's streaming estimate brackets the offline truth:
+      ``recall_offline`` (held-out queries through the service vs the
+      exact oracle) must sit inside the Wilson interval
+      (``canary.oracle_in_interval``)."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import quality
+    from raft_tpu.serve import SearchService, bucket_sizes
+
+    _note("canary: dataset")
+    dataset, qsets = _make_clustered(n, d, max(threads * per_thread, 1000),
+                                     ncl, n_qsets=1, seed=17)
+    jax.block_until_ready([dataset] + qsets)
+    x_host = np.asarray(dataset)
+    pool = np.asarray(qsets[0])
+    eval_q = pool[:n_eval]
+
+    _note("canary: ivf_pq build")
+    t0 = time.perf_counter()
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim,
+                           seed=0), dataset)
+    jax.block_until_ready(idx.list_codes)
+    build_s = time.perf_counter() - t0
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+    m = stream.MutableIndex(idx, search_params=sp, dataset=x_host,
+                            name="canary")
+    canary = quality.RecallCanary(
+        quality.exact_oracle(m), k=k, sample_rate=0.0, reservoir=1024,
+        buckets=bucket_sizes(max_batch), name="canary")
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max(4 * max_batch * threads, 256),
+                        canary=canary)
+    svc.publish("canary", m, k=k)
+    m.warm(svc.buckets, ks=(k,))
+    _note("canary: oracle warm")
+    canary.warm()
+
+    # offline truth at warmed bucket shapes: the served pipeline's recall
+    # vs the exact live-corpus oracle on held-out queries
+    got = []
+    for lo in range(0, n_eval, max_batch):
+        _, ids = svc.search("canary", eval_q[lo:lo + max_batch], k)
+        got.append(np.asarray(ids))
+    _, oracle_ids = m.exact_search(eval_q, k)
+    recall_offline = _recall(np.concatenate(got), np.asarray(oracle_ids))
+
+    failures = []
+
+    def loaded_window():
+        def worker(tid):
+            for j in range(per_thread):
+                qi = (tid + j * threads) % pool.shape[0]
+                try:
+                    svc.search("canary", pool[qi:qi + 1], k)
+                except Exception as e:  # pragma: no cover - fails the row
+                    failures.append(f"{type(e).__name__}: {str(e)[:80]}")
+        ws = [threading.Thread(target=worker, args=(t,))
+              for t in range(threads)]
+        t0 = time.perf_counter()
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join(600)
+        return threads * per_thread / (time.perf_counter() - t0)
+
+    qps_by_rate = {}
+    with obs_compile.attribution() as rec:
+        for rate in rates:
+            _note(f"canary: loaded window at rate {rate:g}")
+            canary.set_rate(rate)
+            if rate > 0:
+                canary.start(poll_interval_s=0.005)
+            qps_by_rate[f"{rate:g}"] = round(loaded_window(), 1)
+            if rate > 0:
+                canary.stop()  # drains the tail INSIDE the attribution
+    svc.shutdown()  # free the worker threads + index before later rows
+    est = canary.estimate()
+    base = qps_by_rate[f"{rates[0]:g}"]
+    worst = qps_by_rate[f"{rates[-1]:g}"]
+    rows.append({
+        "name": "canary_smoke_100k",
+        "qps": base,
+        "qps_by_rate": qps_by_rate,
+        "slowdown_at_5pct": round(base / max(worst, 1e-9), 3),
+        "recall_offline": round(recall_offline, 4),
+        "canary": {
+            "recall": round(est["recall"], 4),
+            "wilson_low": round(est["wilson_low"], 4),
+            "wilson_high": round(est["wilson_high"], 4),
+            "reranked": est["reranked"], "seen": est["seen"],
+            "oracle_in_interval": bool(canary.in_interval(recall_offline)),
+        },
+        "build_s": round(build_s, 1),
+        "threads": threads, "max_batch": max_batch,
+        # zero-cold-compile proof with the canary ON: sampling, draining
+        # and reranking across the whole loaded window compiled nothing
+        "compile_s": round(rec.compile_s, 3),
+        "cache_misses": rec.cache_misses,
+        "failed": len(failures),
         "failures": failures[:5],
     })
 
@@ -1275,6 +1480,11 @@ def _run(rows):
         _emit()
 
     if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "canary_smoke_100k",
+                   lambda: _row_canary_smoke(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "tune_smoke_10k", lambda: _row_tune_smoke(rows))
         _emit()
 
@@ -1354,6 +1564,13 @@ def main(argv=None):
                        lambda: _row_serve_churn(rows))
             _row_guard(rows, "serve_churn_cagra_100k",
                        lambda: _row_serve_churn_cagra(rows))
+        elif "--canary-smoke" in argv:
+            # canary overhead loop only (ISSUE 8): sampling-rate QPS A/B +
+            # the compile-free-hot-path proof with live quality monitoring
+            # on; the heavy drift sweep is bench/drift_sweep.py
+            _setup(rows)
+            _row_guard(rows, "canary_smoke_100k",
+                       lambda: _row_canary_smoke(rows))
         elif "--tune-smoke" in argv:
             # autotune loop proof only (ISSUE 7): the quick iteration
             # path for the tune sweep engine; heavy sweeps are

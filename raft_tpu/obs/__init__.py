@@ -13,6 +13,18 @@ control, and a bench harness that always writes structured results
   pairwise_distance, select_k, kmeans).
 - :mod:`.compile` — jax.monitoring subscription splitting compile vs execute
   and counting persistent-cache hits/misses.
+- :mod:`.quality` — ONLINE quality: the live recall canary (reservoir
+  sampling at the serve flush path, exact shadow rerank over the live
+  corpus, streaming recall@k with a Wilson interval) and dataset-family
+  drift detection against pinned tune decisions.
+- :mod:`.slo` — availability/latency/quality objectives with multi-window
+  error-budget burn rates over an injected-clock ring; the ``/healthz``
+  verdict.
+- :mod:`.requestlog` — request ids minted at admission, span timings
+  through batcher → flush → lease → index search → stream merge, served
+  at ``/debug/requests`` with latency-bucket exemplars.
+- :mod:`.http` — the opt-in stdlib endpoint routing ``/metrics``,
+  ``/healthz`` and ``/debug/requests`` (404 elsewhere).
 
 Trace annotation (the NVTX analogue) lives in :mod:`raft_tpu.core.tracing`;
 per-collective counters ride inside :mod:`raft_tpu.comms.comms`; the serving
@@ -29,20 +41,29 @@ from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
 from . import http
 from . import metrics
+from . import quality
+from . import requestlog
+from . import slo
 from .compile import CompileRecord, attribution
 from .http import MetricsExporter, start_http_exporter, stop_http_exporter
 # NOTE: this deliberately rebinds the package attribute `obs.instrument` from
 # the submodule to the decorator (the ergonomic call site); reach the helper
 # fns via `from raft_tpu.obs.instrument import nrows`, not attribute access.
 from .instrument import instrument
-from .metrics import (DEFAULT_BUCKETS, Registry, counter, delta, disable,
-                      enable, enabled, gauge, histogram, quantile, reset,
-                      snapshot, to_json, to_prometheus)
+from .metrics import (DEFAULT_BUCKETS, RATIO_BUCKETS, Registry, counter,
+                      delta, disable, enable, enabled, gauge, histogram,
+                      quantile, reset, snapshot, to_json, to_prometheus)
+from .quality import DriftDetector, RecallCanary, exact_oracle, wilson_interval
+from .requestlog import RequestLog
+from .slo import SLOPolicy, SLOTracker
 
 __all__ = [
     "metrics", "compile", "http", "instrument", "attribution",
     "CompileRecord", "MetricsExporter", "start_http_exporter",
-    "stop_http_exporter", "Registry", "DEFAULT_BUCKETS", "counter", "gauge",
-    "histogram", "snapshot", "to_prometheus", "to_json", "delta", "quantile",
-    "reset", "enable", "disable", "enabled",
+    "stop_http_exporter", "Registry", "DEFAULT_BUCKETS", "RATIO_BUCKETS",
+    "counter", "gauge", "histogram", "snapshot", "to_prometheus", "to_json",
+    "delta", "quantile", "reset", "enable", "disable", "enabled",
+    "quality", "slo", "requestlog", "RecallCanary", "DriftDetector",
+    "exact_oracle", "wilson_interval", "SLOPolicy", "SLOTracker",
+    "RequestLog",
 ]

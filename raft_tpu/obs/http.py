@@ -1,24 +1,34 @@
-"""Opt-in stdlib HTTP exporter: the serve tier becomes scrapeable without
-a wrapper framework.
+"""Opt-in stdlib HTTP endpoint: metrics scrape, health verdict, request log.
 
-``start_http_exporter(port)`` serves :func:`raft_tpu.obs.to_prometheus`
-from a daemon-threaded stdlib ``http.server`` — every GET path returns the
-text exposition format (Prometheus convention is ``/metrics``; the path is
-not enforced so a curl against ``/`` works too). Nothing starts unless the
-process asks: no port is opened at import, and the exporter holds no lock
-while rendering beyond the registry's own snapshot lock.
+``start_http_exporter(port)`` serves three explicitly routed paths from a
+daemon-threaded stdlib ``http.server``:
+
+- ``/metrics`` — the Prometheus text exposition of the registry;
+- ``/healthz`` — the SLO verdict (ready/degraded/failing as JSON; 503 on
+  failing so load balancers eject the replica) when an
+  :class:`raft_tpu.obs.slo.SLOTracker` is attached, else a bare
+  ``{"status": "ready"}``;
+- ``/debug/requests`` — the request-trace ring
+  (:class:`raft_tpu.obs.requestlog.RequestLog`) when one is attached.
+
+Every other path is a 404 — a scrape-config typo fails loudly at
+deploy time instead of silently scraping metrics from ``/metrcs`` forever
+(earlier revisions served the exposition on every GET path; the lint
+value of the 404 outweighs the curl convenience). Nothing starts unless
+the process asks: no port is opened at import, and the exporter holds no
+lock while rendering beyond the registry's own snapshot lock.
 
     from raft_tpu import obs
 
-    exp = obs.start_http_exporter(9100)   # or port=0 for an ephemeral port
-    ...                                    # scrape http://host:exp.port/metrics
-    exp.stop()                             # clean shutdown (also a context
-                                           # manager; atexit not required —
-                                           # the thread is a daemon)
+    exp = obs.start_http_exporter(9100, slo=tracker, request_log=rlog)
+    ...        # scrape http://host:exp.port/metrics; probe /healthz
+    exp.stop()  # clean shutdown (also a context manager; atexit not
+                # required — the thread is a daemon)
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -28,32 +38,69 @@ __all__ = ["MetricsExporter", "start_http_exporter", "stop_http_exporter"]
 
 # Prometheus text exposition content type (version 0.0.4 is the text format)
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+_JSON_TYPE = "application/json; charset=utf-8"
 
 _lock = threading.Lock()
 _active: "MetricsExporter | None" = None
 
 
 class MetricsExporter:
-    """One running exporter: a ThreadingHTTPServer on a daemon thread."""
+    """One running exporter: a ThreadingHTTPServer on a daemon thread.
+    ``slo``/``request_log`` are optional sources for ``/healthz`` and
+    ``/debug/requests`` (see module doc)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: metrics.Registry | None = None):
+                 registry: metrics.Registry | None = None,
+                 slo=None, request_log=None):
         reg = registry or metrics.default_registry()
+        exporter = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - http.server API
-                body = reg.to_prometheus().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", _CONTENT_TYPE)
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, _CONTENT_TYPE,
+                               reg.to_prometheus().encode())
+                elif path == "/healthz":
+                    if exporter.slo is None:
+                        code, body = 200, {"status": "ready", "slo": None,
+                                           "note": "no SLO tracker attached"}
+                    else:
+                        code, body = exporter.slo.healthz()
+                    self._send(code, _JSON_TYPE,
+                               json.dumps(body, default=float).encode())
+                elif path == "/debug/requests":
+                    if exporter.request_log is None:
+                        self._send(404, _JSON_TYPE, json.dumps(
+                            {"error": "no request log attached — pass "
+                                      "request_log= to the exporter"}
+                        ).encode())
+                    else:
+                        self._send(200, _JSON_TYPE, json.dumps(
+                            exporter.request_log.to_json(),
+                            default=float).encode())
+                else:
+                    # explicit routing: unknown paths fail loudly instead of
+                    # silently answering a typo'd scrape config with metrics
+                    self._send(404, "text/plain; charset=utf-8",
+                               (f"unknown path {path!r}; endpoints: "
+                                "/metrics, /healthz, /debug/requests\n"
+                                ).encode())
 
             def log_message(self, fmt, *args):
                 # scrapes every few seconds must not spam stderr; the
                 # request count is observable from the scraper side
                 pass
 
+        self.slo = slo
+        self.request_log = request_log
         self._server = ThreadingHTTPServer((host, int(port)), Handler)
         self._server.daemon_threads = True
         self.host = host
@@ -80,21 +127,24 @@ class MetricsExporter:
 
 
 def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
-                        registry: metrics.Registry | None = None
-                        ) -> MetricsExporter:
-    """Start (or return the already-running) metrics HTTP endpoint.
+                        registry: metrics.Registry | None = None,
+                        slo=None, request_log=None) -> MetricsExporter:
+    """Start (or return the already-running) obs HTTP endpoint.
 
     ``port=0`` binds an ephemeral port (read it off the returned
     ``.port``); ``host`` defaults to loopback — bind "0.0.0.0" explicitly
-    to expose beyond the machine. One exporter per process through this
-    module-level entry (a second call returns the live one); construct
-    :class:`MetricsExporter` directly for multiples or custom registries.
+    to expose beyond the machine. ``slo=``/``request_log=`` attach the
+    ``/healthz`` and ``/debug/requests`` sources. One exporter per process
+    through this module-level entry (a second call returns the live one —
+    attach sources on the first call); construct :class:`MetricsExporter`
+    directly for multiples or custom registries.
     """
     global _active
     with _lock:
         if _active is not None:
             return _active
-        _active = MetricsExporter(port=port, host=host, registry=registry)
+        _active = MetricsExporter(port=port, host=host, registry=registry,
+                                  slo=slo, request_log=request_log)
         return _active
 
 

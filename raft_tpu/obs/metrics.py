@@ -16,6 +16,12 @@ Semantics worth knowing:
   ``inc(k="5", op="a")`` hit the same series.
 - **Histograms** use fixed cumulative buckets (Prometheus convention); the
   default bucket ladder spans 100 us .. 60 s, sized for call latencies.
+  Metrics whose values live on [0, 1] — recall, ratios, fractions — pass
+  ``buckets=RATIO_BUCKETS`` instead (a latency ladder would dump every
+  observation into the first two buckets and :func:`quantile` would report
+  garbage). Re-registering a histogram under a different bucket ladder
+  raises: the first registration would otherwise silently win and the
+  later call site would read quantiles against buckets it never asked for.
   :func:`quantile` interpolates within the owning bucket.
 """
 
@@ -29,13 +35,19 @@ from typing import Iterable
 __all__ = [
     "Registry", "counter", "gauge", "histogram", "snapshot", "to_prometheus",
     "to_json", "delta", "quantile", "reset", "enable", "disable", "enabled",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "RATIO_BUCKETS",
 ]
 
 # Latency ladder: 100 us .. 60 s (jit dispatch to cold 1M build).
 DEFAULT_BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# [0, 1] ladder for recall/ratio/fraction metrics: dense near 1.0, where
+# recall lives (the gap between 0.95 and 0.99 is the whole quality story).
+RATIO_BUCKETS = (
+    0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 0.99, 1.0,
 )
 
 _enabled = True
@@ -120,9 +132,10 @@ class Metric:
             return {k: (list(v) if isinstance(v, list) else v)
                     for k, v in self._series.items()}
 
-    def quantile(self, q: float, **labels) -> float:
+    def quantile(self, q: float, /, **labels) -> float:
         """Histogram quantile estimate by linear interpolation inside the
-        owning bucket (Inf bucket reports the last finite bound)."""
+        owning bucket (Inf bucket reports the last finite bound). ``q`` is
+        positional-only so a series labeled ``q=...`` stays addressable."""
         assert self.kind == "histogram", "quantile() is histogram-only"
         key = _label_key(labels)
         with self._lock:
@@ -163,6 +176,13 @@ class Registry:
                 raise ValueError(
                     f"metric {name!r} already registered as {m.kind}, "
                     f"requested {kind}")
+            elif kind == "histogram" and m.buckets != buckets:
+                # first-registration-wins would silently hand the later call
+                # site quantiles over a bucket ladder it never asked for
+                # (e.g. a recall metric read against the latency ladder)
+                raise ValueError(
+                    f"histogram {name!r} already registered with buckets "
+                    f"{m.buckets}, requested {buckets}")
             return m
 
     def counter(self, name: str, help: str = "", unit: str = "") -> Metric:
@@ -232,7 +252,11 @@ class Registry:
     def to_json(self) -> dict:
         """Flat {'name{l1="v1",...}': number} view — subtractable, and small
         enough to ride inside a BENCH row. Histograms flatten to _sum/_count
-        (the bucket vector stays in :meth:`snapshot`)."""
+        plus one ``_bucket`` key per cumulative bucket with the series'
+        OWN labels preserved alongside ``le`` — so a BENCH artifact carries
+        the full per-bucket distribution (the canary's per-bucket recall
+        histogram) without collapsing label sets, and :func:`delta`
+        subtracts bucket counts like any other monotone series."""
         out = {}
         for name, meta in self.snapshot().items():
             for s in meta["series"]:
@@ -240,6 +264,9 @@ class Registry:
                 if meta["type"] == "histogram":
                     out[f"{name}_sum{lbl}"] = s["sum"]
                     out[f"{name}_count{lbl}"] = s["count"]
+                    for le, cum in s["buckets"].items():
+                        blbl = _label_str({**s["labels"], "le": le})
+                        out[f"{name}_bucket{blbl}"] = cum
                 else:
                     out[f"{name}{lbl}"] = s["value"]
         return out
@@ -317,7 +344,9 @@ def to_json() -> dict:
     return _default.to_json()
 
 
-def quantile(name: str, q: float, **labels) -> float:
+def quantile(name: str, q: float, /, **labels) -> float:
+    # positional-only: the serve/stream/quality series all carry a `name`
+    # label, which must not collide with the metric-name parameter
     return _default._metrics[name].quantile(q, **labels)
 
 

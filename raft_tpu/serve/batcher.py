@@ -27,6 +27,7 @@ loop around the same drain path.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 import time
@@ -36,7 +37,7 @@ from typing import Callable, Sequence
 
 from ..core import tracing
 from ..core.errors import expects
-from ..obs import metrics
+from ..obs import metrics, requestlog
 from .errors import DeadlineExceededError, ServiceClosedError
 
 __all__ = ["MicroBatcher", "bucket_sizes", "bucket_for"]
@@ -137,6 +138,7 @@ class _Request:
     future: Future
     enqueued: float        # clock() at submit
     deadline: float | None  # clock()-domain absolute deadline, or None
+    rid: str | None = None  # request-log id minted at admission
 
 
 @dataclass
@@ -167,9 +169,19 @@ class MicroBatcher:
                  *, max_batch: int = 64, max_wait_us: float = 1000.0,
                  clock: Callable[[], float] = time.monotonic,
                  stream: str = "default", start: bool = True,
-                 on_dequeue: Callable[[int], None] | None = None):
+                 on_dequeue: Callable[[int], None] | None = None,
+                 request_log=None, slo=None,
+                 on_result: Callable | None = None):
         expects(max_wait_us >= 0, "max_wait_us must be >= 0")
         self._flush_fn = flush_fn
+        # observability taps (all optional, all OFF the result path):
+        # request_log records per-request span traces, slo feeds the
+        # latency objective from the queue-wait/flush decomposition, and
+        # on_result(valid_queries, valid_outputs) is the recall canary's
+        # flush tap — a raising tap must never fail the batch
+        self._request_log = request_log
+        self._slo = slo
+        self._on_result = on_result
         self.max_batch = int(max_batch)
         self.buckets = bucket_sizes(self.max_batch)
         self.max_wait_s = float(max_wait_us) * 1e-6
@@ -193,12 +205,15 @@ class MicroBatcher:
             self._worker.start()
 
     # -- submission ---------------------------------------------------------
-    def submit(self, rows, *, deadline: float | None = None) -> Future:
+    def submit(self, rows, *, deadline: float | None = None,
+               rid: str | None = None) -> Future:
         """Enqueue a ``(r, d)`` row block; returns a Future resolving to the
         per-row slice of the flush result. ``deadline`` is absolute, in the
-        injected clock's domain. Raises :class:`ServiceClosedError` after
-        :meth:`close`; a request wider than ``max_batch`` is refused (split
-        at the caller — one request never spans two flushes)."""
+        injected clock's domain; ``rid`` is the request-log id minted at
+        admission (traced through the flush). Raises
+        :class:`ServiceClosedError` after :meth:`close`; a request wider
+        than ``max_batch`` is refused (split at the caller — one request
+        never spans two flushes)."""
         expects(getattr(rows, "ndim", 0) == 2,
                 "submit expects a (rows, d) block")
         n = int(rows.shape[0])
@@ -218,7 +233,7 @@ class MicroBatcher:
                         "stream %r batches (*, %d) %s rows; got (*, %d) %s",
                         self.stream, self._row_shape[0], self._row_shape[1],
                         shape[0], shape[1])
-            self._pending.append(_Request(rows, n, fut, now, deadline))
+            self._pending.append(_Request(rows, n, fut, now, deadline, rid))
             self._pending_rows += n
             if metrics._enabled:
                 _queue_depth().set(self._pending_rows, stream=self.stream)
@@ -291,6 +306,17 @@ class MicroBatcher:
 
     def _flush_expired(self, drained: _Drained, now: float) -> None:
         for r in drained.expired:
+            if self._request_log is not None:
+                self._request_log.complete(
+                    r.rid, stream=self.stream, rows=r.n,
+                    spans={"queue": now - r.enqueued},
+                    outcome="expired")
+            if self._slo is not None:
+                # an expired request IS a latency-bad outcome: the caller
+                # waited its full deadline and got an error — a saturated
+                # service shedding at the deadline must burn the latency
+                # budget, not report 'ready' over the surviving minority
+                self._slo.record_request(now - r.enqueued, float("inf"))
             _fail(r.future, DeadlineExceededError(
                 f"deadline expired after {now - r.enqueued:.6f}s in queue "
                 f"(stream {self.stream!r})"))
@@ -318,6 +344,10 @@ class MicroBatcher:
                                               stream=self.stream)
             _occupancy().observe(n_valid / bucket, stream=self.stream)
             _flush_total().inc(1, stream=self.stream, bucket=bucket)
+        spans: dict = {}
+        notes: dict = {}
+        t_flush = now  # assembly failures still get a sane flush wall
+        col = None
         try:
             # assembly stays INSIDE the guard: the drained futures are
             # already pinned (set_running_or_notify_cancel), so any escape
@@ -329,20 +359,69 @@ class MicroBatcher:
                 q = np.concatenate([q, pad])
             with tracing.range("serve/flush/%d", bucket):
                 t_flush = self._clock()
-                out = tuple(np.asarray(a) for a in self._flush_fn(q))
+                # span collector: the flush fn (and anything below it —
+                # registry lease, stream search) records its stage walls
+                # against this batch's request ids
+                collector = (requestlog.collect()
+                             if self._request_log is not None
+                             else contextlib.nullcontext())
+                with collector as col:
+                    out = tuple(np.asarray(a) for a in self._flush_fn(q))
+                flush_dt = self._clock() - t_flush
+                if col is not None:
+                    spans, notes = col.spans, col.notes
                 if metrics._enabled:
-                    _flush_seconds().observe(self._clock() - t_flush,
-                                             stream=self.stream)
+                    _flush_seconds().observe(flush_dt, stream=self.stream)
         except Exception as e:
             _error_total().inc(1, stream=self.stream)
+            flush_dt = self._clock() - t_flush
             for r in batch:
                 _fail(r.future, e)
+            if col is not None:
+                # salvage whatever stages completed before the raise — the
+                # error trace is the one that most needs the attribution
+                # (e.g. serve/lease recorded, serve/search missing says
+                # the search stage failed)
+                spans, notes = col.spans, col.notes
+            self._observe_batch(batch, now, bucket, flush_dt, spans, notes,
+                                outcome="error")
             return n_valid
         off = 0
         for r in batch:
             r.future.set_result(tuple(a[off:off + r.n] for a in out))
             off += r.n
+        # observability taps run AFTER the futures resolve: the request
+        # log / SLO loops and the canary's per-row sampling must never add
+        # to any caller's observed latency
+        self._observe_batch(batch, now, bucket, flush_dt, spans, notes,
+                            outcome="ok")
+        if self._on_result is not None:
+            try:
+                self._on_result(q[:n_valid],
+                                tuple(a[:n_valid] for a in out))
+            except Exception:  # a canary tap must never fail the batch
+                pass
         return n_valid
+
+    def _observe_batch(self, batch, now: float, bucket: int, flush_dt: float,
+                       spans: dict, notes: dict, outcome: str) -> None:
+        """Per-request observability after one flush: the request-log trace
+        (queue span per request + the batch's shared flush/stage spans) and
+        the SLO latency objective (queue wait + flush wall vs the bound; a
+        failed flush counts as latency-bad — the caller got an error after
+        waiting)."""
+        if self._request_log is None and self._slo is None:
+            return
+        for r in batch:
+            wait = now - r.enqueued
+            if self._request_log is not None:
+                self._request_log.complete(
+                    r.rid, stream=self.stream, rows=r.n, bucket=bucket,
+                    spans={"queue": wait, "flush": flush_dt, **spans},
+                    notes=notes, outcome=outcome)
+            if self._slo is not None:
+                self._slo.record_request(
+                    wait, flush_dt if outcome == "ok" else float("inf"))
 
     def pump(self, *, force: bool = False) -> int:
         """Synchronously sweep expired requests, then drain-and-flush once if

@@ -36,7 +36,7 @@ from typing import Callable
 
 from ..core import tracing
 from ..core.errors import expects
-from ..obs import metrics
+from ..obs import metrics, requestlog
 from .batcher import MicroBatcher, bucket_sizes, _deadline_total
 from .errors import (DeadlineExceededError, OverloadedError,
                      ServiceClosedError)
@@ -94,6 +94,15 @@ class SearchService:
     a lone request waits at most this long before flushing under-full.
     ``default_timeout_s`` applies to requests submitted without an explicit
     timeout (``None`` = no deadline).
+
+    The online-quality hooks (all optional, docs/observability.md):
+    ``canary`` (an :class:`raft_tpu.obs.quality.RecallCanary`) taps every
+    flush of the canary's published name into its reservoir sampler;
+    ``slo`` (an :class:`raft_tpu.obs.slo.SLOTracker`) receives every
+    admission outcome and every served request's queue-wait/flush split;
+    ``request_log`` (an :class:`raft_tpu.obs.requestlog.RequestLog`) mints
+    a request id at admission and collects span timings through
+    queue → flush → registry lease → index search → stream merge.
     """
 
     def __init__(self, registry: IndexRegistry | None = None, *,
@@ -101,7 +110,8 @@ class SearchService:
                  max_queue_rows: int = 4096,
                  default_timeout_s: float | None = None,
                  clock: Callable[[], float] = time.monotonic,
-                 start_workers: bool = True):
+                 start_workers: bool = True,
+                 canary=None, slo=None, request_log=None):
         self.buckets = bucket_sizes(max_batch)
         self.registry = registry or IndexRegistry(buckets=self.buckets,
                                                   clock=clock)
@@ -123,6 +133,16 @@ class SearchService:
         self.default_timeout_s = default_timeout_s
         self._clock = clock
         self._start_workers = start_workers
+        expects(canary is None or (hasattr(canary, "offer")
+                                   and hasattr(canary, "name")),
+                "canary must be an obs.quality.RecallCanary (offer()/name)")
+        expects(slo is None or hasattr(slo, "record_admission"),
+                "slo must be an obs.slo.SLOTracker (record_admission())")
+        expects(request_log is None or hasattr(request_log, "begin"),
+                "request_log must be an obs.requestlog.RequestLog (begin())")
+        self._canary = canary
+        self._slo = slo
+        self._request_log = request_log
         # guards the batcher map + the closed flag; admission uses the
         # leaf-locked _RowCounter instead, so submit never holds this lock
         # across an enqueue
@@ -188,11 +208,26 @@ class SearchService:
                 raise ServiceClosedError("service is shut down")
             b = self._batchers.get(key)
             if b is None:
+                # the canary taps only its own name's flushes AT ITS OWN
+                # WIDTH — another stream's results (or the same name served
+                # at a different k) scored against this oracle would be a
+                # category error, not a recall estimate: |top-k' ∩ exact
+                # top-k| / k inflates toward 1 for k' > k and caps at k'/k
+                # below it, and either way feeds false slots into the SLO
+                # quality objective
+                canary = self._canary
+                on_result = None
+                if (canary is not None and canary.name == name
+                        and int(canary.k) == int(k)):
+                    def on_result(queries, out, _c=canary):
+                        _c.offer(queries, out[1])
                 b = MicroBatcher(
                     self._make_flush(name, int(k)),
                     max_batch=self.max_batch, max_wait_us=self.max_wait_us,
                     clock=self._clock, stream=f"{name}.k{k}",
-                    start=self._start_workers, on_dequeue=self._rows.sub)
+                    start=self._start_workers, on_dequeue=self._rows.sub,
+                    request_log=self._request_log, slo=self._slo,
+                    on_result=on_result)
                 self._batchers[key] = b
         return b
 
@@ -200,12 +235,20 @@ class SearchService:
         def flush(padded_queries):
             import jax
 
+            t0 = time.perf_counter()
             with self.registry.lease(name) as v:
+                # span collector no-ops unless this flush is traced; the
+                # leased version pins which index epoch answered
+                requestlog.add_span("serve/lease", time.perf_counter() - t0)
+                requestlog.annotate("version", v.version)
+                t1 = time.perf_counter()
                 out = v.searcher(padded_queries, k)
                 # materialize before scattering: a future that resolves is a
                 # result the caller can use at memcpy cost, and the latency
                 # histograms measure real work, not async dispatch
                 jax.block_until_ready(out)
+                requestlog.add_span("serve/search",
+                                    time.perf_counter() - t1)
             return out
 
         return flush
@@ -270,14 +313,22 @@ class SearchService:
         if not self._rows.try_add(n):
             if metrics._enabled:
                 _overload_total().inc(1, name=name)
+            if self._slo is not None:
+                # the availability objective IS the non-overload admission
+                # fraction: shed load burns error budget
+                self._slo.record_admission(False)
             raise OverloadedError(
                 f"queue at {self._rows.value()}/{self.max_queue_rows} rows; "
                 f"request of {n} refused")
+        rid = (self._request_log.begin(f"{name}.k{k}", n)
+               if self._request_log is not None else None)
         try:
-            fut = b.submit(q, deadline=deadline)
+            fut = b.submit(q, deadline=deadline, rid=rid)
         except BaseException:  # closed/shape refusal: release the rows
             self._rows.sub(n)
             raise
+        if self._slo is not None:
+            self._slo.record_admission(True)
         if metrics._enabled:
             _requests_total().inc(1, stream=f"{name}.k{k}")
         return fut

@@ -102,6 +102,13 @@ class Compactor:
     direct ``MutableIndex.search`` callers pay their own first-touch
     compiles (library mode).
 
+    ``drift`` (an :class:`raft_tpu.obs.quality.DriftDetector`) re-runs the
+    tune family classifier on compaction-time corpus stats: each fold that
+    leaves a retained row store feeds a corpus subsample plus the live row
+    count into :meth:`DriftDetector.check` — the corpus-side half of the
+    drift → retune loop (docs/tuning.md; the query-side half rides the
+    recall canary).
+
     ``clock`` is injected for the age watermark and the tests; the
     background worker (``start()``) polls ``run_once`` on the real wall
     clock and exists for deployments — tests drive :meth:`run_once`
@@ -111,7 +118,8 @@ class Compactor:
     def __init__(self, mutable: MutableIndex, *, publisher=None,
                  name: str | None = None, ks=(10,),
                  policy: CompactionPolicy = CompactionPolicy(),
-                 warm_data=None, clock: Callable[[], float] | None = None,
+                 warm_data=None, drift=None,
+                 clock: Callable[[], float] | None = None,
                  poll_interval_s: float = 0.05):
         expects(publisher is None or hasattr(publisher, "publish"),
                 "publisher must expose publish() (SearchService or "
@@ -124,6 +132,9 @@ class Compactor:
         self._ks = (ks,) if isinstance(ks, int) else tuple(ks)
         self.policy = policy
         self._warm_data = warm_data
+        expects(drift is None or hasattr(drift, "check"),
+                "drift must be an obs.quality.DriftDetector (check())")
+        self._drift = drift
         # default to the MUTABLE's clock: the age watermark subtracts this
         # clock's now from delta_oldest_at stamps taken with the mutable's —
         # two different time bases would silently disable (or constantly
@@ -188,6 +199,17 @@ class Compactor:
         wall = time.perf_counter() - t0
         report["wall_s"] = round(wall, 3)
         report["compile_s"] = round(rec.compile_s, 3)
+        if self._drift is not None:
+            # compaction-time corpus stats: the retained store is the live
+            # corpus' raw rows (the classifier subsamples internally; a few
+            # not-yet-reclaimed tombstoned rows are noise at the CV's
+            # decision margins). No store → the corpus side cannot
+            # classify; the query-side canary feed still covers the pin.
+            st = self._mutable._state
+            if st.store is not None:
+                report["drift"] = self._drift.check(
+                    rows=st.store, n_rows=max(self._mutable.size, 1),
+                    dim=self._mutable.dim, source="compaction")
         if metrics._enabled:
             _c_compactions().inc(1, name=name, trigger=trigger,
                                  mode=report["mode"])

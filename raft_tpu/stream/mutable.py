@@ -257,13 +257,17 @@ class _StreamState:
     __slots__ = ("cfg", "sealed", "id_map", "sealed_alive", "store",
                  "delta", "delta_ids", "delta_alive", "delta_n",
                  "delta_oldest_at", "epoch", "id_map_dev", "sealed_keep_dev",
-                 "delta_view")
+                 "delta_view", "store_dev")
 
     def __init__(self, cfg: _Config):
         self.cfg = cfg
         self.delta_n = 0
         self.delta_oldest_at = None
         self.epoch = 0
+        # device copy of the retained row store, built lazily on the first
+        # exact_search of an epoch (the recall canary's shadow oracle) —
+        # never on the serving hot path
+        self.store_dev = None
 
 
 def _np_dtype(query_dtype: str):
@@ -321,11 +325,17 @@ def _search_state(st: _StreamState, queries, k: int, res=None):
     """Unified search over one state epoch: sealed(filtered) + delta scan,
     merged through select_k, ids mapped to the global space. All device
     handles are snapshotted up front, so a concurrent write (which replaces
-    handles, never mutates them) cannot tear this call."""
+    handles, never mutates them) cannot tear this call. Stage walls are
+    recorded as ``stream/sealed`` / ``stream/delta`` / ``stream/merge``
+    request-log spans (host dispatch walls — jax is async; no-op unless a
+    collector is open on this thread) plus the state epoch, so a traced
+    flush attributes to a concrete index epoch and stream stage."""
     from ..neighbors import brute_force
+    from ..obs import requestlog
 
     jnp = _jnp()
     cfg = st.cfg
+    requestlog.annotate("stream_epoch", st.epoch)
     # handle snapshot — one consistent view (delta_view is assigned as one
     # tuple, sealed/id_map are frozen per epoch, sealed_keep only changes
     # VALUES within an epoch, never shape). ORDER MATTERS: the delta view
@@ -345,13 +355,21 @@ def _search_state(st: _StreamState, queries, k: int, res=None):
     if cfg.query_dtype == "float32":
         queries = queries.astype(jnp.float32)
     k = int(k)
+    t0 = time.perf_counter()
     sd, si = _sealed_search(cfg, sealed, queries, k, skeep, res=res)
     si = _map_ids(si, imap)
+    t1 = time.perf_counter()
     kd = min(k, delta.shape[0])
     dd, di = brute_force.knn(delta, queries, kd, cfg.metric, cfg.metric_arg,
                              sample_filter=dkeep, res=res)
     di = _map_ids(di, dids)
-    return _merge(sd, si, dd, di, k, cfg.select_min)
+    t2 = time.perf_counter()
+    out = _merge(sd, si, dd, di, k, cfg.select_min)
+    t3 = time.perf_counter()
+    requestlog.add_span("stream/sealed", t1 - t0)
+    requestlog.add_span("stream/delta", t2 - t1)
+    requestlog.add_span("stream/merge", t3 - t2)
+    return out
 
 
 # -- the mutable index -------------------------------------------------------
@@ -624,6 +642,60 @@ class MutableIndex:
         ``(distances (m, k), global ids (m, k))`` with the shared
         ``id -1 / ±inf`` sentinel in slots the live rows cannot fill."""
         return _search_state(self._state, queries, k, res=res)
+
+    def exact_search(self, queries, k: int, res=None):
+        """EXACT fused kNN over the live corpus — the recall canary's
+        shadow oracle (:func:`raft_tpu.obs.quality.exact_oracle`). The
+        sealed side scans the retained raw row store through the same
+        tombstone keep-mask the serving path filters with; the delta side
+        is the usual exact bucket scan; both merge through ``select_k``
+        and map to global ids. Needs the retained store (``dataset=`` /
+        ``retain_vectors=True`` — PQ codes cannot reconstruct rows).
+
+        Off the hot path by design: the store's device copy uploads
+        lazily once per compaction epoch, and the brute-force program is
+        keyed on the epoch's sealed row count — warm it per epoch
+        (``RecallCanary.warm``; the churn bench covers epochs by
+        rehearsal). Handle-snapshot ordering matches :meth:`search`, so a
+        concurrent write cannot tear the view."""
+        from ..neighbors import brute_force
+
+        jnp = _jnp()
+        st = self._state
+        cfg = self._cfg
+        # same snapshot discipline and ORDER as _search_state: delta view
+        # before the sealed keep-mask (pairs with upsert's kill-then-reveal)
+        delta, dkeep, dids, _ = st.delta_view
+        skeep, imap = st.sealed_keep_dev, st.id_map_dev
+        store_dev = self._store_device(st)
+        queries = jnp.asarray(queries)
+        expects(queries.ndim == 2 and queries.shape[1] == cfg.dim,
+                "queries must be (rows, %d)", cfg.dim)
+        if cfg.query_dtype == "float32":
+            queries = queries.astype(jnp.float32)
+        k = int(k)
+        ks = min(k, store_dev.shape[0])
+        sd, si = brute_force.knn(store_dev, queries, ks, cfg.metric,
+                                 cfg.metric_arg, sample_filter=skeep, res=res)
+        si = _map_ids(si, imap)
+        kd = min(k, delta.shape[0])
+        dd, di = brute_force.knn(delta, queries, kd, cfg.metric,
+                                 cfg.metric_arg, sample_filter=dkeep, res=res)
+        di = _map_ids(di, dids)
+        return _merge(sd, si, dd, di, k, cfg.select_min)
+
+    def _store_device(self, st: _StreamState):
+        """The epoch-frozen device copy of the retained row store (lazy;
+        a benign publication race uploads at most twice — the store array
+        itself is never mutated within an epoch)."""
+        expects(st.store is not None,
+                "exact_search needs the retained row store "
+                "(retain_vectors=True / dataset= at wrap time)")
+        dev = st.store_dev
+        if dev is None:
+            dev = _jnp().asarray(st.store)
+            st.store_dev = dev
+        return dev
 
     def searcher(self):
         """Serving hook pinned to the CURRENT state epoch (the

@@ -36,7 +36,8 @@ from ..core.errors import expects
 
 __all__ = [
     "Decision", "DecisionLog", "shape_family", "family_of", "kind_of",
-    "SKEW_CV_THRESHOLD",
+    "local_scale_cv", "list_size_cv",
+    "SCALE_CV_THRESHOLD", "SKEW_CV_THRESHOLD",
 ]
 
 # Skew classifiers, calibrated on the CPU mesh (tune.reference families).
@@ -137,7 +138,7 @@ def kind_of(index) -> str:
     return table[name]
 
 
-def _list_size_cv(list_sizes) -> float:
+def list_size_cv(list_sizes) -> float:
     import jax
     import numpy as np
 
@@ -148,12 +149,15 @@ def _list_size_cv(list_sizes) -> float:
     return float(sizes.std() / sizes.mean())
 
 
-def _local_scale_cv(dataset, sample: int = 1024) -> float:
+def local_scale_cv(dataset, sample: int = 1024) -> float:
     """CV of nearest-neighbor radii over a deterministic row subsample
     (one (sample, sample) GEMM on host — cheap at any scale, and
     independent of how any index balanced its lists). The measured
     heavytail discriminator: lognormal per-cluster residual scales read
-    ~1.5, isotropic clustered data ~0.4 (see SCALE_CV_THRESHOLD)."""
+    ~1.5, isotropic clustered data ~0.4 (see SCALE_CV_THRESHOLD). Public:
+    :class:`raft_tpu.obs.quality.DriftDetector` re-runs this classifier
+    ONLINE — on canary query samples and compaction-time corpus stats —
+    to detect the live distribution leaving a pinned decision's family."""
     import jax
     import numpy as np
 
@@ -184,7 +188,7 @@ def family_of(index, dataset=None) -> str:
     kind = kind_of(index)
     if kind == "brute_force":
         n, d = index.dataset.shape
-        balance = ("skew" if _local_scale_cv(index.dataset)
+        balance = ("skew" if local_scale_cv(index.dataset)
                    > SCALE_CV_THRESHOLD else "bal")
     elif kind == "cagra":
         n, d = index.size, index.dim
@@ -192,7 +196,7 @@ def family_of(index, dataset=None) -> str:
     else:  # ivf_flat / ivf_pq
         n, d = index.size, index.dim
         balance = "bal"
-        if _list_size_cv(index.list_sizes) > SKEW_CV_THRESHOLD:
+        if list_size_cv(index.list_sizes) > SKEW_CV_THRESHOLD:
             balance = "skew"
         else:
             if dataset is None and kind == "ivf_flat":
@@ -214,7 +218,7 @@ def family_of(index, dataset=None) -> str:
                 ids = np.asarray(jax.device_get(
                     index.list_ids[::lstep, :per_list]))
                 dataset = data.reshape(-1, d)[ids.reshape(-1) >= 0]
-            if dataset is not None and _local_scale_cv(
+            if dataset is not None and local_scale_cv(
                     dataset) > SCALE_CV_THRESHOLD:
                 balance = "skew"
     return shape_family(n, d, balance)

@@ -275,6 +275,16 @@ def test_serve_churn_row_smoke():
     # toy-scale PQ: parity only loosely; the 0.01 bar is the 100k row's
     assert abs(row["recall_gap"]) < 0.25, row
     assert row["recall_mut"] > 0.3, row
+    # the live recall canary rides this row (ISSUE 8): the estimate exists,
+    # its interval is well-formed, and the zero-cold-compile assertion
+    # above now ALSO covers the canary's sampling + shadow reranks (they
+    # ran inside the attributed window, rehearsal-warmed per epoch)
+    c = row["canary"]
+    assert c is not None and c["rate"] == 0.05, row
+    assert c["reranked"] > 0 and c["seen"] > 0, row
+    assert c["wilson_low"] <= c["recall"] <= c["wilson_high"], row
+    # toy-scale bracket: the 100k driver row asserts oracle_in_interval
+    assert abs(c["recall"] - row["recall_mut"]) < 0.35, row
 
 
 def test_serve_churn_flag_runs_only_the_churn_rows(monkeypatch):
@@ -380,6 +390,90 @@ def test_build_ab_table_renders_from_artifact():
         assert needle in table, (needle, table)
     # a markdown table: header + separator + one line per arm
     assert table.count("|") > 30
+
+
+def test_canary_smoke_row():
+    """The --canary-smoke bench row (ISSUE 8 acceptance measurement): QPS
+    at sampling 0% vs 1% vs 5% with the background drainer reranking
+    live, the Wilson interval bracketing the offline recall, and ZERO cold
+    compiles across the whole monitored window (the canary's oracle was
+    warmed at every rerank bucket). Shrunk shapes; absolute overhead
+    numbers are the TPU driver row's job."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_canary_smoke(rows, n=2500, d=32, n_lists=16, pq_dim=16, k=5,
+                            n_probes=16, threads=3, per_thread=40,
+                            rates=(0.0, 0.05, 0.25), max_batch=8,
+                            max_wait_us=500.0, ncl=32, n_eval=64)
+    row = rows[-1]
+    assert row["name"] == "canary_smoke_100k" and "error" not in row, rows
+    assert row["failed"] == 0, row
+    assert set(row["qps_by_rate"]) == {"0", "0.05", "0.25"}, row
+    assert all(v > 0 for v in row["qps_by_rate"].values()), row
+    assert row["slowdown_at_5pct"] > 0, row
+    # live monitoring must not compile anything, on or off the hot path
+    assert row["compile_s"] == 0.0, row
+    assert row["cache_misses"] == 0, row
+    c = row["canary"]
+    assert c["reranked"] > 0 and c["seen"] > 0, row
+    assert c["wilson_low"] <= c["recall"] <= c["wilson_high"], row
+    # the acceptance bracket: offline truth inside the live interval
+    assert c["oracle_in_interval"], row
+    assert abs(c["recall"] - row["recall_offline"]) < 0.2, row
+
+
+def test_canary_smoke_flag_runs_only_the_canary_row(monkeypatch):
+    """`bench.py --canary-smoke` is the quality-layer iteration loop: setup
+    + the canary row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_canary_smoke",
+        lambda rows: rows.append({"name": "canary_smoke_100k", "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--canary-smoke"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "canary_smoke_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()
+
+
+def test_drift_sweep_small_scale():
+    """bench/drift_sweep.py at CI scale: the heavytail twin fires the
+    detector, the isotropic one stays silent, on both the query-sample and
+    compaction-stat feeds (the ISSUE 8 satellite sweep; full scales run on
+    the driver)."""
+    import importlib.util
+    import pathlib
+
+    import pytest
+
+    pytest.importorskip("jax")
+    spec = importlib.util.spec_from_file_location(
+        "drift_sweep", pathlib.Path(__file__).resolve().parents[1]
+        / "bench" / "drift_sweep.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    artifact = mod.run_sweep(mod.SMALL_SCALES)
+    assert len(artifact["rows"]) == 2
+    by = {r["name"].split("_")[1]: r for r in artifact["rows"]}
+    assert by["heavytail"]["ok"] and by["heavytail"]["compaction"]["drifted"]
+    assert by["isotropic"]["ok"] and not by["isotropic"]["queries"]["drifted"]
+    # drift state is per feed: the query-sample AND compaction-stat feeds
+    # each advise once on their own transition
+    assert by["heavytail"]["retune_events"] == 2
+    assert by["isotropic"]["retune_events"] == 0
+    table = mod.render_table(artifact)
+    assert "drift_heavytail_2k_d32" in table and "**ok**" in table
 
 
 def test_tune_smoke_row():

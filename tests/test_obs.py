@@ -597,8 +597,11 @@ class TestHttpExporter:
         reg = obs.Registry()
         reg.gauge("raft_tpu_serve_queue_depth", "rows").set(7, stream="s")
         with obs.MetricsExporter(port=0, registry=reg) as exp:
+            # the exposition lives at /metrics ONLY (explicit routing —
+            # tests/test_obs_quality.py covers the 404 contract)
             body = urllib.request.urlopen(
-                f"http://127.0.0.1:{exp.port}/", timeout=5).read().decode()
+                f"http://127.0.0.1:{exp.port}/metrics",
+                timeout=5).read().decode()
         assert 'raft_tpu_serve_queue_depth{stream="s"} 7' in body
         # the default registry's series must NOT leak into a custom one
         assert "raft_tpu_compile" not in body
