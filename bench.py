@@ -59,6 +59,21 @@ archives per round:
                                  wall (churn.compaction_wall_s); the r07
                                  mini-batch coarse EM + sharded builds
                                  surface here as write throughput.
+  serve_shard_churn_100k         sharded serving tier (ISSUE 9):
+                                 ShardedMutableIndex(ivf_flat) scatter-
+                                 gathered over 1/2/4/8 device-pinned
+                                 shards at proportional operating points —
+                                 closed-loop QPS per shard count
+                                 (qps_by_shards, scaling_1_to_4, cores),
+                                 then a mixed read/write churn window at
+                                 the top shard count with STAGGERED
+                                 one-shard-per-cycle compactions (>= 2
+                                 folds, churn.failed == 0), zero cold
+                                 compiles (rehearsal-warmed; includes the
+                                 mesh-wide canary's shadow reranks), and
+                                 the fresh-oracle recall inside the live
+                                 canary's Wilson interval. `--serve-shard`
+                                 runs ONLY this row.
   canary_smoke_100k              raft_tpu.obs.quality overhead A/B
                                  (ISSUE 8): closed-loop served QPS with
                                  canary sampling at 0% vs 1% vs 5% (the
@@ -1082,6 +1097,279 @@ def _serve_churn_impl(rows, *, name, note, build, materialize, search_params,
     })
 
 
+def _row_serve_shard(rows, n=100_000, d=128, n_lists=1024, k=10,
+                     n_probes=32, shard_counts=(1, 2, 4, 8), threads=8,
+                     per_thread=150, writer_steps=48, upserts_per_step=96,
+                     deletes_per_step=32, delta_capacity=2048,
+                     compact_fill=0.5, max_batch=64, max_wait_us=2000.0,
+                     ncl=2000, n_eval=512, canary_rate=0.05):
+    """Sharded serving tier (ISSUE 9): the whole serve+stream stack
+    scatter-gathered across the mesh — ShardedMutableIndex(ivf_flat) at
+    1/2/4/8 shards, per-shard operating points sized PROPORTIONALLY
+    (``n_lists/S`` lists, ``n_probes/S`` probes — constant scanned-corpus
+    fraction, so recall holds and total per-query compute is flat while
+    the critical path spreads over S devices; docs/using_comms.md
+    "Serving-tier sizing").
+
+    Claims riding in the row (the ROADMAP-1 done-bar):
+    - ``qps_by_shards`` + ``scaling_1_to_4`` — closed-loop served QPS per
+      shard count; scaling = (qps[4]/qps[1])/4, i.e. the fraction of
+      linear. On real multi-chip hardware the per-shard searches execute
+      concurrently (one device each — candidates, never rows, cross the
+      interconnect); on a CPU mesh the virtual devices share host cores,
+      so the ceiling is min(S, cores)/S — ``cores`` rides in the row so
+      the artifact prices that in.
+    - **staggered mid-load compaction**: a writer churns
+      upserts+deletes while readers serve; the Compactor folds ONE shard
+      per cycle (>= 2 folds, distinct-shard staggering recorded in
+      ``churn.compaction_shards``) with ``churn.failed == 0`` across every
+      fold's warm republish.
+    - **zero cold compiles** across the whole loaded churn window — every
+      flush, every fold, every publish warm, the canary's shadow reranks —
+      proven by obs compile attribution after a rehearsal twin replays the
+      same deterministic schedule (the churn-row protocol, sharded).
+    - **mid-churn recall inside the canary's Wilson interval**: the live
+      RecallCanary shadow-reranks against the exact mesh-wide oracle
+      (``exact_search`` composed through the same one-dispatch merge) and
+      the fresh-oracle offline measurement must land inside its interval
+      (``canary.oracle_in_interval``)."""
+    import os
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import quality
+    from raft_tpu.serve import SearchService, bucket_sizes
+
+    total_upserts = writer_steps * upserts_per_step
+    total_deletes = writer_steps * deletes_per_step
+    assert total_deletes < n, "delete schedule exceeds the dataset"
+
+    _note("shard: dataset")
+    dataset, qsets = _make_clustered(n + total_upserts, d, max(n_eval, 1000),
+                                     ncl, n_qsets=1, seed=23)
+    jax.block_until_ready([dataset] + qsets)
+    x_host = np.asarray(dataset[:n])
+    churn_host = np.asarray(dataset[n:])
+    pool = np.asarray(qsets[0])
+    eval_q = pool[:n_eval]
+    devs = jax.devices()
+
+    def make_sharded(S, name):
+        # proportional sizing: constant scanned-corpus fraction per query
+        nl = max(n_lists // S, 8)
+        sp = ivf_flat.SearchParams(n_probes=max(n_probes // S, 1))
+        return stream.ShardedMutableIndex(
+            x_host, n_shards=S,
+            build=lambda rows: ivf_flat.build(
+                ivf_flat.IndexParams(n_lists=nl, seed=0), rows),
+            search_params=sp, delta_capacity=delta_capacity,
+            devices=[devs[s % len(devs)] for s in range(S)], name=name)
+
+    # ---- read-only QPS ladder over shard counts --------------------------
+    qps_by_shards = {}
+    failures = []
+
+    def loaded_window(svc, name):
+        def worker(tid):
+            for j in range(per_thread):
+                qi = (tid + j * threads) % pool.shape[0]
+                try:
+                    svc.search(name, pool[qi:qi + 1], k)
+                except Exception as e:  # pragma: no cover - fails the row
+                    failures.append(f"{type(e).__name__}: {str(e)[:80]}")
+        ws = [threading.Thread(target=worker, args=(t,))
+              for t in range(threads)]
+        t0 = time.perf_counter()
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join(600)
+        return threads * per_thread / (time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for S in shard_counts:
+        _note(f"shard: build + serve at {S} shard(s)")
+        sm = make_sharded(S, f"mesh{S}")
+        svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                            max_queue_rows=max(4 * max_batch * threads, 256))
+        svc.publish("mesh", sm, k=k)
+        sm.warm(svc.buckets, ks=(k,))
+        loaded_window(svc, "mesh")  # warm the closed loop itself
+        qps_by_shards[str(S)] = round(loaded_window(svc, "mesh"), 1)
+        svc.shutdown()
+        del sm, svc
+    build_s = time.perf_counter() - t0
+
+    # ---- staggered-compaction churn at the largest shard count -----------
+    S = shard_counts[-1]
+    policy = stream.CompactionPolicy(delta_fill=compact_fill,
+                                     tombstone_ratio=None, max_age_s=None)
+
+    def write_schedule(sm, comp, on_step=None, after_compact=None):
+        reports = []
+        for step in range(writer_steps):
+            lo = step * upserts_per_step
+            sm.upsert(churn_host[lo:lo + upserts_per_step],
+                      ids=n + np.arange(lo, lo + upserts_per_step))
+            dlo = step * deletes_per_step
+            sm.delete(np.arange(dlo, dlo + deletes_per_step))
+            while comp.due():
+                reports.append(comp.run_once())  # ONE shard per cycle
+                if after_compact is not None:
+                    after_compact()
+            if on_step is not None:
+                on_step(step, len(reports))
+        return reports
+
+    _note(f"shard: rehearsal at {S} shards (compiles the epoch program set)")
+    m0 = make_sharded(S, "shard-rehearsal")
+    svc0 = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                         max_queue_rows=max(4 * max_batch * threads, 256))
+    svc0.publish("shard-rehearsal", m0, k=k)
+    m0.warm(svc0.buckets, ks=(k,))
+    canary0 = quality.RecallCanary(
+        quality.exact_oracle(m0), k=k, sample_rate=0.0,
+        buckets=bucket_sizes(max_batch), name="shard-rehearsal")
+    canary0.warm()
+    comp0 = stream.Compactor(m0, publisher=svc0, name="shard-rehearsal",
+                             ks=(k,), policy=policy)
+    write_schedule(m0, comp0, after_compact=canary0.warm)
+    svc0.shutdown()
+    del m0, comp0, canary0, svc0
+
+    _note(f"shard: live churn window at {S} shards, {threads} readers")
+    sm = make_sharded(S, "shard")
+    canary = quality.RecallCanary(
+        quality.exact_oracle(sm), k=k, sample_rate=canary_rate,
+        reservoir=1024, buckets=bucket_sizes(max_batch), name="shard")
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max(4 * max_batch * threads, 256),
+                        canary=canary)
+    svc.publish("shard", sm, k=k)
+    sm.warm(svc.buckets, ks=(k,))
+    canary.warm()
+    comp = stream.Compactor(sm, publisher=svc, name="shard", ks=(k,),
+                            policy=policy)
+
+    done = threading.Event()
+    lats, served = [], [0]
+    lock = threading.Lock()
+    eval_box = {}
+
+    def reader(tid):
+        my_lats, j = [], 0
+        while not done.is_set():
+            qi = (tid + j * threads) % pool.shape[0]
+            j += 1
+            t0 = time.perf_counter()
+            try:
+                svc.search("shard", pool[qi:qi + 1], k)
+            except Exception as e:  # pragma: no cover - fails the row
+                with lock:
+                    failures.append(f"{type(e).__name__}: {str(e)[:80]}")
+                continue
+            my_lats.append(time.perf_counter() - t0)
+        with lock:
+            lats.extend(my_lats)
+            served[0] += len(my_lats)
+
+    def on_step(step, n_compactions):
+        canary.drain()  # shadow reranks on the writer cadence, off-path
+        if step == writer_steps // 2 and "ids" not in eval_box:
+            got = []
+            for lo in range(0, n_eval, max_batch):
+                _, ids = svc.search("shard", eval_q[lo:lo + max_batch], k)
+                got.append(np.asarray(ids))
+            eval_box["ids"] = np.concatenate(got)
+            eval_box["del_done"] = (step + 1) * deletes_per_step
+            eval_box["ins_done"] = (step + 1) * upserts_per_step
+
+    with obs_compile.attribution() as rec:
+        workers = [threading.Thread(target=reader, args=(t,))
+                   for t in range(threads)]
+        t_load = time.perf_counter()
+        for w in workers:
+            w.start()
+        t_write = time.perf_counter()
+        reports = write_schedule(sm, comp, on_step)
+        write_s = time.perf_counter() - t_write
+        done.set()
+        for w in workers:
+            w.join(600)
+        canary.drain()
+        load_s = time.perf_counter() - t_load
+    svc.shutdown()
+
+    # ---- fresh oracle over the mid-churn live rows -----------------------
+    _note("shard: fresh-oracle build over the mid-churn live set")
+    del_done, ins_done = eval_box["del_done"], eval_box["ins_done"]
+    live_mat = np.concatenate([x_host[del_done:], churn_host[:ins_done]])
+    live_gids = np.concatenate([np.arange(del_done, n),
+                                n + np.arange(ins_done)])
+    _, gt_pos = brute_force.knn(live_mat, eval_q, k)
+    gt_gids = live_gids[np.asarray(gt_pos)]
+    recall_mut = _recall(eval_box["ids"], gt_gids)
+    oracle = ivf_flat.build(
+        ivf_flat.IndexParams(n_lists=n_lists, seed=0), live_mat)
+    jax.block_until_ready(oracle.list_data)
+    _, o_pos = ivf_flat.search(ivf_flat.SearchParams(n_probes=n_probes),
+                               oracle, eval_q, k)
+    o_pos = np.asarray(o_pos)
+    oracle_gids = np.where(o_pos >= 0, live_gids[np.clip(o_pos, 0, None)], -1)
+    recall_oracle = _recall(oracle_gids, gt_gids)
+
+    lats_ms = np.sort(np.array(lats if lats else [0.0])) * 1e3
+    est = canary.estimate()
+    q1 = qps_by_shards.get(str(shard_counts[0]), 0)
+    q4 = qps_by_shards.get("4")
+    rows.append({
+        "name": "serve_shard_churn_100k",
+        "qps": round(served[0] / load_s, 1),
+        "qps_by_shards": qps_by_shards,
+        "scaling_1_to_4": (round(q4 / q1 / 4.0, 3)
+                           if q4 and q1 else None),
+        "cores": os.cpu_count(),
+        "shards": S,
+        "p50_ms": round(float(lats_ms[len(lats_ms) // 2]), 3),
+        "p99_ms": round(float(lats_ms[int(len(lats_ms) * 0.99) - 1]), 3),
+        "write_rows_per_s": round(
+            (total_upserts + total_deletes) / write_s, 1),
+        "recall_mut": round(recall_mut, 4),
+        "recall_oracle": round(recall_oracle, 4),
+        "recall_gap": round(recall_mut - recall_oracle, 4),
+        "build_s": round(build_s, 1),
+        "threads": threads, "max_batch": max_batch,
+        "delta_capacity": delta_capacity,
+        "canary": {
+            "rate": canary_rate,
+            "recall": round(est["recall"], 4),
+            "wilson_low": round(est["wilson_low"], 4),
+            "wilson_high": round(est["wilson_high"], 4),
+            "reranked": est["reranked"], "seen": est["seen"],
+            "oracle_in_interval": bool(canary.in_interval(recall_mut)),
+        },
+        "churn": {
+            "failed": len(failures),
+            "compactions": len(reports),
+            # one shard per fold — the staggering record (a global
+            # stop-the-world would show as one shard repeated back-to-back
+            # with every delta full; distinct shards = staggered)
+            "compaction_shards": [r["shard"] for r in reports],
+            "compaction_wall_s": [r["wall_s"] for r in reports],
+            "folded_rows": [r["folded"] for r in reports],
+            "upserts": total_upserts, "deletes": total_deletes,
+            "compile_s": round(rec.compile_s, 3),
+            "cache_misses": rec.cache_misses,
+        },
+        "failures": failures[:5],
+    })
+
+
 def _row_canary_smoke(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
                       n_probes=8, threads=8, per_thread=150,
                       rates=(0.0, 0.01, 0.05), max_batch=64,
@@ -1480,6 +1768,11 @@ def _run(rows):
         _emit()
 
     if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "serve_shard_churn_100k",
+                   lambda: _row_serve_shard(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "canary_smoke_100k",
                    lambda: _row_canary_smoke(rows))
         _emit()
@@ -1564,6 +1857,13 @@ def main(argv=None):
                        lambda: _row_serve_churn(rows))
             _row_guard(rows, "serve_churn_cagra_100k",
                        lambda: _row_serve_churn_cagra(rows))
+        elif "--serve-shard" in argv:
+            # sharded serving tier only (ISSUE 9): the iteration loop for
+            # the scatter-gather serve path — QPS ladder over shard counts
+            # + the staggered-compaction churn window
+            _setup(rows)
+            _row_guard(rows, "serve_shard_churn_100k",
+                       lambda: _row_serve_shard(rows))
         elif "--canary-smoke" in argv:
             # canary overhead loop only (ISSUE 8): sampling-rate QPS A/B +
             # the compile-free-hot-path proof with live quality monitoring

@@ -44,7 +44,7 @@ from typing import Callable
 from . import metrics
 from .metrics import DEFAULT_BUCKETS, _fmt_le
 
-__all__ = ["RequestLog", "collect", "add_span", "annotate"]
+__all__ = ["RequestLog", "collect", "add_span", "annotate", "prefix"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -61,11 +61,12 @@ _tls = threading.local()
 
 
 class _Collector:
-    __slots__ = ("spans", "notes")
+    __slots__ = ("spans", "notes", "pre")
 
     def __init__(self):
         self.spans: dict[str, float] = {}
         self.notes: dict[str, object] = {}
+        self.pre = ""  # active span/note name prefix (see `prefix`)
 
 
 class collect:
@@ -90,6 +91,7 @@ def add_span(name: str, seconds: float) -> None:
     off)."""
     c = getattr(_tls, "collector", None)
     if c is not None:
+        name = c.pre + name
         c.spans[name] = c.spans.get(name, 0.0) + float(seconds)
 
 
@@ -98,7 +100,31 @@ def annotate(key: str, value) -> None:
     active collector."""
     c = getattr(_tls, "collector", None)
     if c is not None:
-        c.notes[key] = value
+        c.notes[c.pre + key] = value
+
+
+class prefix:
+    """Scope a span/note name prefix on the active collector — how the
+    sharded stream tier turns one code path's ``stream/sealed`` /
+    ``stream/delta`` spans into per-shard ``stream/shard<i>/...`` entries,
+    so ``/debug/requests`` attributes tail latency to the straggler shard.
+    Nests (inner prefixes append) and costs one getattr when no collector
+    is open."""
+
+    def __init__(self, p: str):
+        self._p = str(p)
+
+    def __enter__(self) -> "prefix":
+        c = getattr(_tls, "collector", None)
+        self._prev = c.pre if c is not None else None
+        if c is not None:
+            c.pre = c.pre + self._p
+        return self
+
+    def __exit__(self, *exc) -> None:
+        c = getattr(_tls, "collector", None)
+        if c is not None and self._prev is not None:
+            c.pre = self._prev
 
 
 # -- the log -----------------------------------------------------------------

@@ -8,4 +8,24 @@ list-sharded IVF-Flat/IVF-PQ search, and per-shard CAGRA with ICI merge.
 
 from . import cagra, ivf, kmeans, knn
 
-__all__ = ["knn", "kmeans", "ivf", "cagra"]
+__all__ = ["knn", "kmeans", "ivf", "cagra", "release_programs"]
+
+
+def release_programs(comms=None) -> int:
+    """Evict the drivers' memoized jitted programs pinned to ``comms``
+    (every communicator when None) — the mesh-teardown hook: the program
+    caches (``knn._knn_fn``, ``ivf._flat_search_fn``/``_pq_search_fn``,
+    ``cagra._cagra_search_fn``) hold the Comms —
+    and through it the Mesh and its devices — strongly, so a process that
+    churns mesh configs (the sharded serving tier) must release retired
+    ones or they pin memory for the cache's lifetime. Returns how many
+    programs were dropped. Note jax's own trace/executable caches also
+    reference the mesh; pair with ``jax.clear_caches()`` when the goal is
+    releasing device memory, not just this library's references."""
+    caches = (knn._PROGRAMS, ivf._PROGRAMS, cagra._PROGRAMS)
+    if comms is None:
+        n = sum(len(c) for c in caches)
+        for c in caches:
+            c.clear()
+        return n
+    return sum(c.release(comms) for c in caches)

@@ -1,0 +1,76 @@
+"""Releasable memoization for the distributed drivers' jitted programs.
+
+The drivers memoize one jitted shard_map program per static config
+(``parallel/knn._knn_fn``, ``parallel/cagra._cagra_search_fn``) — the
+Round-5 fix for the fresh-closure retrace overhead. Those caches key on
+the live :class:`~raft_tpu.comms.Comms` instance, and the cached program
+closures hold it (and through it the Mesh and its devices) strongly: a
+retired mesh stays pinned in memory for the cache's lifetime. That was
+fine when a process owned one mesh forever; the sharded serving tier
+churns mesh configs, so the caches must be evictable per communicator.
+
+This is the plain-dict replacement for the old ``functools.lru_cache``:
+same bounded-LRU semantics and hit behavior (same key → the SAME program
+object, so nothing retraces), plus :meth:`release` — drop every entry
+keyed on one comms — and :meth:`clear`. Callers go through
+:func:`raft_tpu.parallel.release_programs` at mesh teardown; pair it with
+``jax.clear_caches()`` when the goal is releasing device memory too (jax's
+own trace/executable caches also reference the mesh).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable
+
+__all__ = ["ProgramCache"]
+
+
+class ProgramCache:
+    """Thread-safe bounded LRU keyed on ``(comms, *static_config)``.
+
+    The first key element must be the communicator — that is what
+    :meth:`release` matches on. ``build`` runs UNDER the cache lock: it
+    only constructs a jit wrapper (no trace, no compile — cheap and
+    non-reentrant), and an insert that raced a concurrent
+    :meth:`release` of the same communicator would otherwise re-pin the
+    mesh the release just claimed to free."""
+
+    def __init__(self, maxsize: int = 256):
+        self.maxsize = int(maxsize)
+        self._d: collections.OrderedDict = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_build(self, key: tuple, build: Callable):
+        with self._lock:
+            fn = self._d.get(key)
+            if fn is None:
+                fn = self._d[key] = build()
+                while len(self._d) > self.maxsize:
+                    self._d.popitem(last=False)
+            else:
+                self._d.move_to_end(key)
+            return fn
+
+    def release(self, comms) -> int:
+        """Evict every program whose key's communicator == ``comms``;
+        returns how many were dropped."""
+        with self._lock:
+            dead = [k for k in self._d if k[0] == comms]
+            for k in dead:
+                del self._d[k]
+        return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def keys_for(self, comms) -> list:
+        """The cached keys pinned to one communicator (leak-check hook)."""
+        with self._lock:
+            return [k for k in self._d if k[0] == comms]

@@ -18,8 +18,6 @@ top-k candidates.
 from __future__ import annotations
 
 import dataclasses
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -27,6 +25,7 @@ from jax.sharding import PartitionSpec as P
 from ..comms.comms import Comms, replicated, shard_along
 from ..core import tracing
 from ..core.errors import expects
+from ._progcache import ProgramCache
 from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
 from ..obs.instrument import instrument, nrows
@@ -227,12 +226,25 @@ def search(comms: Comms, params: SearchParams, index: ShardedCagraIndex,
     return fn(*args, replicated(mesh, as_key(params.seed)))
 
 
-@functools.lru_cache(maxsize=256)
+_PROGRAMS = ProgramCache(maxsize=256)
+
+
 def _cagra_search_fn(comms: Comms, k: int, itopk: int, max_iter: int,
                      width: int, sqrt_out: bool, seed_pool: int,
                      hop_impl: str, metric, rows: int):
     """Memoized jitted program per static config (see parallel/knn._knn_fn
-    — a fresh jax.jit wrapper per call forces a retrace per search)."""
+    — a fresh jax.jit wrapper per call forces a retrace per search);
+    releasable per communicator (parallel.release_programs)."""
+    key = (comms, k, itopk, max_iter, width, sqrt_out, seed_pool, hop_impl,
+           metric, rows)
+    return _PROGRAMS.get_or_build(key, lambda: _build_cagra_search_fn(
+        comms, k, itopk, max_iter, width, sqrt_out, seed_pool, hop_impl,
+        metric, rows))
+
+
+def _build_cagra_search_fn(comms: Comms, k: int, itopk: int, max_iter: int,
+                           width: int, sqrt_out: bool, seed_pool: int,
+                           hop_impl: str, metric, rows: int):
     size = comms.size()
     inner = metric == DistanceType.InnerProduct
 

@@ -26,8 +26,6 @@ drivers:
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,6 +36,7 @@ from ..core import tracing
 from ..core.errors import expects
 from ..distance.types import DistanceType
 from ..matrix.select_k import _select_k
+from ._progcache import ProgramCache
 from ..neighbors.ivf_flat import IvfFlatIndex, SearchParams, _ivf_search
 from ..obs.instrument import instrument, nrows
 
@@ -85,11 +84,21 @@ def _pad_lists_to_multiple(index: IvfFlatIndex, size: int) -> IvfFlatIndex:
     )
 
 
-@functools.lru_cache(maxsize=256)
+_PROGRAMS = ProgramCache(maxsize=256)
+
+
 def _flat_search_fn(comms: Comms, n_probes: int, k: int, metric,
                     split_factor: float, data_kind: str):
     """Memoized jitted program per static config (see parallel/knn._knn_fn:
-    a fresh jax.jit wrapper per call was measured as 38-45% overhead)."""
+    a fresh jax.jit wrapper per call was measured as 38-45% overhead);
+    releasable per communicator (parallel.release_programs)."""
+    key = (comms, "flat", n_probes, k, metric, split_factor, data_kind)
+    return _PROGRAMS.get_or_build(key, lambda: _build_flat_search_fn(
+        comms, n_probes, k, metric, split_factor, data_kind))
+
+
+def _build_flat_search_fn(comms: Comms, n_probes: int, k: int, metric,
+                          split_factor: float, data_kind: str):
     size = comms.size()
     inner = metric == DistanceType.InnerProduct
 
@@ -288,7 +297,6 @@ def search_pq(comms: Comms, params, index, queries, k: int,
     return fn(*args)
 
 
-@functools.lru_cache(maxsize=256)
 def _pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
                   probe_chunk: int, metric, codebook_kind: str, pq_bits: int,
                   split_factor: float, pq_split: bool, lut_dtype: str,
@@ -296,6 +304,18 @@ def _pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
     """Memoized jitted PQ-search program (see _flat_search_fn); the
     rotation travels as a replicated argument, not a closure constant, so
     two indexes of the same config share one compiled program."""
+    key = (comms, "pq", n_probes, k, query_tile, probe_chunk, metric,
+           codebook_kind, pq_bits, split_factor, pq_split, lut_dtype,
+           scan_impl)
+    return _PROGRAMS.get_or_build(key, lambda: _build_pq_search_fn(
+        comms, n_probes, k, query_tile, probe_chunk, metric, codebook_kind,
+        pq_bits, split_factor, pq_split, lut_dtype, scan_impl))
+
+
+def _build_pq_search_fn(comms: Comms, n_probes: int, k: int, query_tile: int,
+                        probe_chunk: int, metric, codebook_kind: str,
+                        pq_bits: int, split_factor: float, pq_split: bool,
+                        lut_dtype: str, scan_impl: str):
     from ..neighbors.ivf_pq import IvfPqIndex, _pq_search
 
     size = comms.size()

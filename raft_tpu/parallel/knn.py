@@ -17,8 +17,6 @@ never see the shard-divisibility invariant.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -30,11 +28,16 @@ from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
 from ..neighbors.brute_force import _bf_knn, _bf_knn_fused, _fused_eligible
 from ..obs.instrument import instrument, nrows
+from ._progcache import ProgramCache
 
 __all__ = ["knn"]
 
+# memoized jitted programs per (comms, static config) — releasable per
+# communicator at mesh teardown (parallel.release_programs), since the
+# cached closures pin the Comms/Mesh/devices they were staged for
+_PROGRAMS = ProgramCache(maxsize=256)
 
-@functools.lru_cache(maxsize=256)
+
 def _knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
             tile: int, inner_tile: int, compute: str, use_fused: bool,
             shard_rows: int, has_keep: bool):
@@ -43,6 +46,16 @@ def _knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
     retrace per search — measured as a 38-45% driver overhead on a 1-device
     mesh (BASELINE.md "Round-5 parallel-driver overhead"); with the program
     cached the overhead is the collectives' true cost."""
+    key = (comms, k, mt, metric_arg, tile, inner_tile, compute, use_fused,
+           shard_rows, has_keep)
+    return _PROGRAMS.get_or_build(key, lambda: _build_knn_fn(
+        comms, k, mt, metric_arg, tile, inner_tile, compute, use_fused,
+        shard_rows, has_keep))
+
+
+def _build_knn_fn(comms: Comms, k: int, mt: DistanceType, metric_arg: float,
+                  tile: int, inner_tile: int, compute: str, use_fused: bool,
+                  shard_rows: int, has_keep: bool):
     size = comms.size()
     select_min = mt != DistanceType.InnerProduct
 

@@ -19,20 +19,28 @@ fresh/sealed split of FreshDiskANN (Singh et al. 2021; PAPERS.md):
   a cold program and in-flight leases drain on the old epoch.
 - :func:`save`/:func:`load` — the full mutable state (sealed + delta +
   tombstones + id map) as one ``stream`` file section (raft_tpu/8).
+- :class:`ShardedMutableIndex` — the same lifecycle scatter-gathered
+  across a mesh: S device-pinned shards with hash-routed writes
+  (:func:`shard_of`), one ``select_k`` merge over every shard's
+  sealed+delta candidates, and STAGGERED per-shard compaction (one shard
+  folded per Compactor cycle — no global stop-the-world). Serve, canary
+  and request tracing resolve it duck-typed.
 
 Worked example + consistency model: docs/streaming.md. Metrics
 (``raft_tpu_stream_*``): docs/observability.md. The serve write path
 (`SearchService.upsert/delete`) routes here: docs/serving.md.
 """
 
-from . import compactor, mutable
+from . import compactor, mutable, sharded
 from .compactor import CompactionPolicy, Compactor
 from .mutable import (DELTA_MIN_BUCKET, DeltaFullError, MutableIndex,
                       delta_buckets, load, save)
+from .sharded import ShardedMutableIndex, shard_of
 
 __all__ = [
-    "mutable", "compactor",
+    "mutable", "compactor", "sharded",
     "MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET", "delta_buckets",
+    "ShardedMutableIndex", "shard_of",
     "Compactor", "CompactionPolicy",
     "save", "load",
 ]

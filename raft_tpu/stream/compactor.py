@@ -94,6 +94,13 @@ class CompactionPolicy:
 class Compactor:
     """Watermark-driven compaction for one mutable index (see module doc).
 
+    Also drives a :class:`raft_tpu.stream.ShardedMutableIndex` unchanged —
+    its ``stats()`` reports the BINDING shard's watermarks and its
+    ``compact()`` folds one shard per call, so each ``run_once`` here is
+    one STAGGERED shard fold + warm republish (no global stop-the-world);
+    while a watermark stays tripped, successive polls walk shard after
+    shard (docs/streaming.md "Sharded lifecycle").
+
     ``publisher`` is optional: a :class:`~raft_tpu.serve.SearchService` or
     :class:`~raft_tpu.serve.IndexRegistry` (anything with ``publish``) plus
     ``name``/``ks`` — each compaction then republishes the post-swap
@@ -127,6 +134,14 @@ class Compactor:
         expects(publisher is None or name is not None,
                 "a publisher needs the published name")
         self._mutable = mutable
+        # a sharded index picks WHICH shard to fold from the tripped
+        # watermark (an age trip must chase the stalest shard, not the
+        # fullest — starvation otherwise); plain MutableIndex.compact has
+        # no such choice and takes no trigger
+        import inspect
+
+        self._compact_takes_trigger = (
+            "trigger" in inspect.signature(mutable.compact).parameters)
         self._publisher = publisher
         self._pub_name = name
         self._ks = (ks,) if isinstance(ks, int) else tuple(ks)
@@ -184,7 +199,8 @@ class Compactor:
         name = self._mutable.name
         t0 = time.perf_counter()
         with obs_compile.attribution() as rec:
-            report = self._mutable.compact(mode=mode, res=res)
+            kw = {"trigger": trigger} if self._compact_takes_trigger else {}
+            report = self._mutable.compact(mode=mode, res=res, **kw)
             report["trigger"] = trigger
             if self._publisher is not None:
                 # publish AFTER the swap: the registry warms the new epoch's
@@ -203,12 +219,13 @@ class Compactor:
             # compaction-time corpus stats: the retained store is the live
             # corpus' raw rows (the classifier subsamples internally; a few
             # not-yet-reclaimed tombstoned rows are noise at the CV's
-            # decision margins). No store → the corpus side cannot
-            # classify; the query-side canary feed still covers the pin.
-            st = self._mutable._state
-            if st.store is not None:
+            # decision margins; a sharded index hands back a cross-shard
+            # interleave). No store → the corpus side cannot classify; the
+            # query-side canary feed still covers the pin.
+            store = self._mutable._drift_store()
+            if store is not None:
                 report["drift"] = self._drift.check(
-                    rows=st.store, n_rows=max(self._mutable.size, 1),
+                    rows=store, n_rows=max(self._mutable.size, 1),
                     dim=self._mutable.dim, source="compaction")
         if metrics._enabled:
             _c_compactions().inc(1, name=name, trigger=trigger,

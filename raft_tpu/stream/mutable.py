@@ -57,6 +57,13 @@ from ..serve.errors import OverloadedError
 __all__ = ["MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET",
            "delta_buckets", "save", "load"]
 
+# NOTE on sharding: raft_tpu/stream/sharded.py composes S of these (one per
+# mesh shard, device-pinned via ``device=`` with shard-owned global ids via
+# ``ids=``) into a scatter-gather ShardedMutableIndex; the per-shard scan
+# halves of :func:`_search_state` are exposed as :func:`_scan_state` so the
+# sharded tier can merge ALL shards' sealed+delta candidates through ONE
+# ``select_k`` dispatch instead of S per-shard merges.
+
 # floor of the delta bucket ladder: an empty delta still scans one fully
 # masked bucket of this size, so "delta empty" and "delta tiny" share a
 # program instead of forking the hot path
@@ -245,6 +252,22 @@ class _Config:
     data_kind: str
     query_dtype: str
     name: str
+    # optional device pin (the sharded tier places each shard's arrays —
+    # and therefore its compute — on its own mesh device); None = default
+    device: object = None
+
+
+def _dev_put(cfg: "_Config", x):
+    """Upload a host array to the config's device: COMMITTED when a device
+    pin is set (committed inputs make every downstream program run on the
+    shard's device — jax's placement-follows-committed-args rule is the
+    whole scatter mechanism), plain ``jnp.asarray`` otherwise (identical to
+    the pre-sharding behavior, bit for bit)."""
+    if cfg.device is None:
+        return _jnp().asarray(x)
+    import jax
+
+    return jax.device_put(x, cfg.device)
 
 
 class _StreamState:
@@ -254,8 +277,8 @@ class _StreamState:
     place) on every write, so a search that snapshots the handles is always
     internally consistent without holding the write lock."""
 
-    __slots__ = ("cfg", "sealed", "id_map", "sealed_alive", "store",
-                 "delta", "delta_ids", "delta_alive", "delta_n",
+    __slots__ = ("cfg", "sealed", "id_map", "sealed_alive", "sealed_dead_n",
+                 "store", "delta", "delta_ids", "delta_alive", "delta_n",
                  "delta_oldest_at", "epoch", "id_map_dev", "sealed_keep_dev",
                  "delta_view", "store_dev")
 
@@ -264,6 +287,12 @@ class _StreamState:
         self.delta_n = 0
         self.delta_oldest_at = None
         self.epoch = 0
+        # incremental dead-sealed-slot count (== n - sealed_alive.sum(),
+        # maintained at tombstone/swap time): stats() and the gauge updates
+        # on the write path must not scan the whole bitset per write — the
+        # sharded tier aggregates stats across S shards on EVERY routed
+        # upsert/delete
+        self.sealed_dead_n = 0
         # device copy of the retained row store, built lazily on the first
         # exact_search of an epoch (the recall canary's shadow oracle) —
         # never on the serving hot path
@@ -276,13 +305,11 @@ def _np_dtype(query_dtype: str):
 
 
 def _refresh_sealed_keep(st: _StreamState) -> None:
-    jnp = _jnp()
-    st.sealed_keep_dev = jnp.asarray(st.sealed_alive)
+    st.sealed_keep_dev = _dev_put(st.cfg, st.sealed_alive)
 
 
 def _refresh_delta(st: _StreamState, capacity: int,
                    mask_only: bool = False) -> None:
-    jnp = _jnp()
     b = _bucket_for(st.delta_n, capacity)
     keep = st.delta_alive[:b] & (np.arange(b) < st.delta_n)
     # ONE attribute assignment: a lock-free reader snapshots rows, mask and
@@ -302,9 +329,9 @@ def _refresh_delta(st: _StreamState, capacity: int,
     if mask_only and view is not None and view[3] == b:
         rows_dev, ids_dev = view[0], view[2]
     else:
-        rows_dev = jnp.asarray(st.delta[:b])
-        ids_dev = jnp.asarray(st.delta_ids[:b])
-    st.delta_view = (rows_dev, jnp.asarray(keep), ids_dev, b)
+        rows_dev = _dev_put(st.cfg, st.delta[:b])
+        ids_dev = _dev_put(st.cfg, st.delta_ids[:b])
+    st.delta_view = (rows_dev, _dev_put(st.cfg, keep), ids_dev, b)
 
 
 def _build_loc(st: _StreamState) -> dict:
@@ -321,15 +348,28 @@ def _build_loc(st: _StreamState) -> dict:
     return loc
 
 
-def _search_state(st: _StreamState, queries, k: int, res=None):
-    """Unified search over one state epoch: sealed(filtered) + delta scan,
-    merged through select_k, ids mapped to the global space. All device
-    handles are snapshotted up front, so a concurrent write (which replaces
-    handles, never mutates them) cannot tear this call. Stage walls are
-    recorded as ``stream/sealed`` / ``stream/delta`` / ``stream/merge``
-    request-log spans (host dispatch walls — jax is async; no-op unless a
-    collector is open on this thread) plus the state epoch, so a traced
-    flush attributes to a concrete index epoch and stream stage."""
+def _scan_state(st: _StreamState, queries, k: int, res=None,
+                k_sealed: int | None = None):
+    """The scatter half of a one-epoch search: sealed(filtered) scan +
+    delta scan with slot-local ids mapped to the global space — everything
+    BEFORE the select_k merge, so the sharded tier
+    (:mod:`raft_tpu.stream.sharded`) can collect every shard's candidate
+    sets and merge them through ONE dispatch (the knn_merge_parts contract
+    generalized to mixed sealed+delta parts). All device handles are
+    snapshotted up front, so a concurrent write (which replaces handles,
+    never mutates them) cannot tear this call. Stage walls are recorded as
+    ``stream/sealed`` / ``stream/delta`` request-log spans (host dispatch
+    walls — jax is async; no-op unless a collector is open on this thread)
+    plus the state epoch, so a traced flush attributes to a concrete index
+    epoch and stream stage (the sharded tier prefixes them per shard).
+
+    Returns ``(sealed_d (m, k), sealed_i, delta_d (m, kd), delta_i)`` with
+    global ids and the shared ``-1 / ±inf`` sentinel in unfillable slots.
+    ``k_sealed`` (sharded tier only) narrows the sealed candidate width —
+    a shard with fewer sealed rows than k contributes what it has and the
+    merge pads the rest; the single-device path keeps its k-≤-rows
+    contract untouched.
+    """
     from ..neighbors import brute_force
     from ..obs import requestlog
 
@@ -355,8 +395,9 @@ def _search_state(st: _StreamState, queries, k: int, res=None):
     if cfg.query_dtype == "float32":
         queries = queries.astype(jnp.float32)
     k = int(k)
+    ks = k if k_sealed is None else int(k_sealed)
     t0 = time.perf_counter()
-    sd, si = _sealed_search(cfg, sealed, queries, k, skeep, res=res)
+    sd, si = _sealed_search(cfg, sealed, queries, ks, skeep, res=res)
     si = _map_ids(si, imap)
     t1 = time.perf_counter()
     kd = min(k, delta.shape[0])
@@ -364,11 +405,20 @@ def _search_state(st: _StreamState, queries, k: int, res=None):
                              sample_filter=dkeep, res=res)
     di = _map_ids(di, dids)
     t2 = time.perf_counter()
-    out = _merge(sd, si, dd, di, k, cfg.select_min)
-    t3 = time.perf_counter()
     requestlog.add_span("stream/sealed", t1 - t0)
     requestlog.add_span("stream/delta", t2 - t1)
-    requestlog.add_span("stream/merge", t3 - t2)
+    return sd, si, dd, di
+
+
+def _search_state(st: _StreamState, queries, k: int, res=None):
+    """Unified search over one state epoch: the sealed+delta scan
+    (:func:`_scan_state`) merged through select_k (``stream/merge`` span)."""
+    from ..obs import requestlog
+
+    sd, si, dd, di = _scan_state(st, queries, k, res=res)
+    t0 = time.perf_counter()
+    out = _merge(sd, si, dd, di, int(k), st.cfg.select_min)
+    requestlog.add_span("stream/merge", time.perf_counter() - t0)
     return out
 
 
@@ -392,6 +442,16 @@ class MutableIndex:
     (:func:`raft_tpu.parallel.cagra.merged_builder`), shrinking the rebuild
     wall that bounds sustainable write churn. Like ``search_params`` it is
     runtime configuration: never serialized, supplied fresh to ``load``.
+    ``ids`` (optional, length-n unique non-negative ints) assigns the
+    sealed rows' GLOBAL ids — by default the dense row range the sealed
+    build produced. The sharded tier uses this as its global-id offset
+    map: each shard's sealed index stays a dense local build while its
+    results surface the caller's global id space, and fresh ids continue
+    past ``max(ids)``. ``device`` (optional) pins every device array (and
+    therefore every search program — jax placement follows committed
+    inputs) to one device: the scatter mechanism of
+    :class:`raft_tpu.stream.sharded.ShardedMutableIndex`, where shard ``s``
+    lives on mesh device ``s`` and only candidate tuples ever leave it.
     ``clock`` is injected for deterministic tests (the age watermark's time
     base).
     """
@@ -399,11 +459,20 @@ class MutableIndex:
     def __init__(self, sealed, *, search_params=None, index_params=None,
                  delta_capacity: int = 1024, retain_vectors: bool | None = None,
                  dataset=None, builder: Callable | None = None,
-                 name: str = "default",
+                 ids=None, device=None, name: str = "default",
                  clock: Callable[[], float] = time.monotonic):
         kind, module = _resolve_kind(sealed)
         n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
         expects(n > 0, "cannot wrap an empty sealed index")
+        if device is not None:
+            import jax
+
+            if kind == "brute_force":
+                # BruteForce is not a pytree — move its dataset in place
+                # (the wrap takes ownership of the sealed index anyway)
+                sealed.dataset = jax.device_put(sealed.dataset, device)
+            else:
+                sealed = jax.device_put(sealed, device)
         if kind in ("ivf_flat", "ivf_pq"):
             # the id-map contract: internal ids are the dense row range
             import jax.numpy as jnp
@@ -421,7 +490,7 @@ class MutableIndex:
                       metric=metric, metric_arg=metric_arg,
                       select_min=metric != DistanceType.InnerProduct,
                       dim=d, data_kind=data_kind, query_dtype=query_dtype,
-                      name=name)
+                      name=name, device=device)
         self._cfg = cfg
         self._index_params = index_params
         expects(builder is None or callable(builder),
@@ -432,7 +501,18 @@ class MutableIndex:
         self._clock = clock
         self._lock = threading.RLock()
         self._compact_lock = threading.Lock()
-        self._next_id = n
+        if ids is None:
+            id_map = np.arange(n, dtype=np.int64)
+        else:
+            id_map = np.asarray(ids, np.int64).reshape(-1)
+            expects(id_map.shape == (n,),
+                    "ids= must assign one global id per sealed row (%d), "
+                    "got %d", n, id_map.shape[0])
+            expects(np.unique(id_map).size == n, "ids= must be unique")
+            expects(int(id_map.min()) >= 0, "ids= must be >= 0")
+            expects(int(id_map.max()) < 2 ** 31 - 1,
+                    "ids= must fit int32 (device id maps are int32)")
+        self._next_id = int(id_map.max()) + 1
         self._loc: dict[int, tuple[str, int]] = {}
 
         store = None
@@ -456,16 +536,14 @@ class MutableIndex:
 
         st = _StreamState(cfg)
         st.sealed = sealed
-        st.id_map = np.arange(n, dtype=np.int64)
+        st.id_map = id_map
         st.sealed_alive = np.ones(n, bool)
         st.store = store
         dt = _np_dtype(query_dtype)
         st.delta = np.zeros((self.delta_capacity, d), dt)
         st.delta_ids = np.zeros(self.delta_capacity, np.int32)
         st.delta_alive = np.zeros(self.delta_capacity, bool)
-        import jax.numpy as jnp
-
-        st.id_map_dev = jnp.asarray(st.id_map.astype(np.int32))
+        st.id_map_dev = _dev_put(cfg, st.id_map.astype(np.int32))
         _refresh_sealed_keep(st)
         _refresh_delta(st, self.delta_capacity)
         self._state = st
@@ -505,16 +583,23 @@ class MutableIndex:
         """Live (searchable) rows."""
         with self._lock:
             st = self._state
-            return int(st.sealed_alive.sum()
+            return int(len(st.sealed_alive) - st.sealed_dead_n
                        + st.delta_alive[:st.delta_n].sum())
+
+    def _drift_store(self):
+        """The retained raw-row store (or None) — what a
+        :class:`~raft_tpu.stream.Compactor` feeds the corpus-side drift
+        detector; the sharded tier overrides this with a cross-shard
+        subsample."""
+        return self._state.store
 
     def stats(self) -> dict:
         with self._lock:
             st = self._state
             n_sealed = len(st.sealed_alive)
-            dead = int(n_sealed - st.sealed_alive.sum())
+            dead = int(st.sealed_dead_n)
             return {
-                "live": int(st.sealed_alive.sum()
+                "live": int(n_sealed - dead
                             + st.delta_alive[:st.delta_n].sum()),
                 "sealed_rows": n_sealed,
                 "sealed_dead": dead,
@@ -531,7 +616,7 @@ class MutableIndex:
             return
         name = self._cfg.name
         n_sealed = len(st.sealed_alive)
-        dead = int(n_sealed - st.sealed_alive.sum())
+        dead = int(st.sealed_dead_n)
         _g_delta_fill().set(st.delta_n / self.delta_capacity, name=name)
         _g_delta_rows().set(st.delta_n, name=name)
         _g_tombstone().set(dead / max(n_sealed, 1), name=name)
@@ -611,6 +696,7 @@ class MutableIndex:
             killed += 1
             if loc[0] == "s":
                 st.sealed_alive[loc[1]] = False
+                st.sealed_dead_n += 1
                 sealed_dirty = True
             else:
                 st.delta_alive[loc[1]] = False
@@ -658,6 +744,16 @@ class MutableIndex:
         (``RecallCanary.warm``; the churn bench covers epochs by
         rehearsal). Handle-snapshot ordering matches :meth:`search`, so a
         concurrent write cannot tear the view."""
+        sd, si, dd, di = self._exact_scan(queries, k, res=res)
+        return _merge(sd, si, dd, di, int(k), self._cfg.select_min)
+
+    def _exact_scan(self, queries, k: int, res=None):
+        """The scatter half of :meth:`exact_search` — exact store scan +
+        delta scan with global ids, BEFORE the merge — so the sharded tier
+        composes shard-local exact scans through the same one-dispatch
+        merge as :meth:`search` (the RecallCanary's oracle then covers a
+        whole mesh unchanged). Returns ``(sd (m, ks), si, dd (m, kd),
+        di)``; ``ks``/``kd`` are clamped to the store/bucket rows."""
         from ..neighbors import brute_force
 
         jnp = _jnp()
@@ -682,7 +778,7 @@ class MutableIndex:
         dd, di = brute_force.knn(delta, queries, kd, cfg.metric,
                                  cfg.metric_arg, sample_filter=dkeep, res=res)
         di = _map_ids(di, dids)
-        return _merge(sd, si, dd, di, k, cfg.select_min)
+        return sd, si, dd, di
 
     def _store_device(self, st: _StreamState):
         """The epoch-frozen device copy of the retained row store (lazy;
@@ -693,7 +789,7 @@ class MutableIndex:
                 "(retain_vectors=True / dataset= at wrap time)")
         dev = st.store_dev
         if dev is None:
-            dev = _jnp().asarray(st.store)
+            dev = _dev_put(st.cfg, st.store)
             st.store_dev = dev
         return dev
 
@@ -730,7 +826,6 @@ class MutableIndex:
         from .._warmup import _random_queries
         from ..obs import compile as obs_compile
 
-        jnp = _jnp()
         cfg = self._cfg
         out: dict = {}
         key = jax.random.key(0)
@@ -746,15 +841,21 @@ class MutableIndex:
                 t0 = time.perf_counter()
                 with obs_compile.attribution() as rec:
                     for db in self._buckets:
-                        dummy = jnp.zeros((db, cfg.dim), dt)
-                        keep = jnp.zeros((db,), bool)
+                        # dummies ride _dev_put so a device-pinned shard
+                        # warms programs at the SAME committed placement
+                        # its serving path dispatches (placement is part of
+                        # the executable key — an off-device warm would
+                        # leave the hot path cold)
+                        dummy = _dev_put(cfg, np.zeros((db, cfg.dim), dt))
+                        keep = _dev_put(cfg, np.zeros((db,), bool))
                         kd = min(kk, db)
                         dd, di = brute_force.knn(
                             dummy, q, kd, cfg.metric, cfg.metric_arg,
                             sample_filter=keep)
-                        di = _map_ids(di, jnp.zeros((db,), jnp.int32))
-                        sd = jnp.zeros((b, kk), jnp.float32)
-                        si = jnp.full((b, kk), -1, jnp.int32)
+                        di = _map_ids(di, _dev_put(
+                            cfg, np.zeros((db,), np.int32)))
+                        sd = _dev_put(cfg, np.zeros((b, kk), np.float32))
+                        si = _dev_put(cfg, np.full((b, kk), -1, np.int32))
                         jax.block_until_ready(
                             _merge(sd, si, dd, di, kk, cfg.select_min))
                 out[kk][b] = {"wall_s": round(time.perf_counter() - t0, 3),
@@ -824,7 +925,9 @@ class MutableIndex:
                 new_id_map = np.concatenate([st.id_map[s_src], fold_gids])
                 new_store = live_rows
                 reclaimed = len(st.id_map) - len(s_src)
-                x = jnp.asarray(live_rows)
+                # committed input: a device-pinned shard rebuilds ON its
+                # own device (off the hot path either way)
+                x = _dev_put(cfg, live_rows)
                 if self._builder is not None:
                     new_sealed = self._builder(x, res=res)
                     got_kind, _ = _resolve_kind(new_sealed)
@@ -844,13 +947,23 @@ class MutableIndex:
                             "rebuild compaction of %s needs index_params "
                             "(build configuration)", cfg.kind)
                     new_sealed = cfg.module.build(ip, x, res=res)
+                if cfg.device is not None:
+                    # a builder may construct off-device (e.g. a mesh-
+                    # sharded build); the successor must land back on the
+                    # shard's pin or the next search would mix committed
+                    # devices in one program
+                    if cfg.kind == "brute_force":
+                        new_sealed.dataset = jax.device_put(
+                            new_sealed.dataset, cfg.device)
+                    else:
+                        new_sealed = jax.device_put(new_sealed, cfg.device)
             # materialize before the swap (BruteForce is not a pytree —
             # block on its dataset directly)
             if cfg.kind == "brute_force":
                 jax.block_until_ready(new_sealed.dataset)
             else:
                 jax.block_until_ready(jax.tree_util.tree_leaves(new_sealed))
-            id_map_dev = jnp.asarray(new_id_map.astype(np.int32))
+            id_map_dev = _dev_put(cfg, new_id_map.astype(np.int32))
 
             # ---- atomic swap ---------------------------------------------
             with self._lock:
@@ -867,6 +980,10 @@ class MutableIndex:
                 else:
                     nd.sealed_alive = np.concatenate(
                         [st.sealed_alive[s_src], st.delta_alive[d_src]])
+                # re-based from the concatenated bitset (O(n) once per
+                # fold, never per write)
+                nd.sealed_dead_n = int(len(nd.sealed_alive)
+                                       - nd.sealed_alive.sum())
                 dt = _np_dtype(cfg.query_dtype)
                 nd.delta = np.zeros((self.delta_capacity, cfg.dim), dt)
                 nd.delta_ids = np.zeros(self.delta_capacity, np.int32)
@@ -926,10 +1043,11 @@ def save(mutable: MutableIndex, path: str) -> None:
 
 def load(path: str, *, search_params=None, index_params=None,
          builder: Callable | None = None, name: str | None = None,
+         device=None,
          clock: Callable[[], float] = time.monotonic) -> MutableIndex:
     """Load a :func:`save`d mutable index. ``search_params``/
-    ``index_params``/``builder`` are runtime configuration (like every other
-    index loader) and are supplied fresh here."""
+    ``index_params``/``builder``/``device`` are runtime configuration (like
+    every other index loader) and are supplied fresh here."""
     from ..core.serialize import (check_header, deserialize_mdspan,
                                   deserialize_scalar)
     from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
@@ -955,11 +1073,13 @@ def load(path: str, *, search_params=None, index_params=None,
     m = MutableIndex(sealed, search_params=search_params,
                      index_params=index_params, delta_capacity=capacity,
                      retain_vectors=has_store, dataset=store, builder=builder,
+                     device=device,
                      name=saved_name if name is None else name, clock=clock)
     with m._lock:
         st = m._state
         st.id_map = id_map.astype(np.int64)
         st.sealed_alive = sealed_alive
+        st.sealed_dead_n = int(sealed_alive.size - sealed_alive.sum())
         st.delta[:delta_n] = delta
         st.delta_ids[:delta_n] = delta_ids
         st.delta_alive[:delta_n] = delta_alive
@@ -970,9 +1090,7 @@ def load(path: str, *, search_params=None, index_params=None,
         # firing)
         st.delta_oldest_at = clock() if delta_n else None
         m._next_id = next_id
-        import jax.numpy as jnp
-
-        st.id_map_dev = jnp.asarray(st.id_map.astype(np.int32))
+        st.id_map_dev = _dev_put(st.cfg, st.id_map.astype(np.int32))
         _refresh_sealed_keep(st)
         _refresh_delta(st, capacity)
         m._loc = _build_loc(st)

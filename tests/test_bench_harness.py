@@ -517,3 +517,74 @@ def test_tune_smoke_flag_runs_only_the_tune_row(monkeypatch):
                    for r in bench._STATE["rows"])
     finally:
         bench._STATE["rows"].clear()
+
+
+def test_serve_shard_row_smoke():
+    """The --serve-shard bench row (ISSUE 9 acceptance measurement) must
+    produce a full row: a QPS ladder over shard counts, >= 2 STAGGERED
+    one-shard-per-cycle compactions with zero failed queries, the
+    rehearsal-backed zero-cold-compile proof (canary reranks included),
+    and the fresh-oracle recall inside the live canary's interval. Shrunk
+    shapes — absolute QPS scaling is the driver row's job (the row carries
+    `cores` so the artifact prices the CPU-mesh ceiling in)."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_serve_shard(rows, n=2400, d=32, n_lists=32, k=5, n_probes=16,
+                           shard_counts=(1, 2), threads=3, per_thread=20,
+                           writer_steps=12, upserts_per_step=24,
+                           deletes_per_step=8, delta_capacity=128,
+                           compact_fill=0.5, max_batch=8, max_wait_us=500.0,
+                           ncl=32, n_eval=64)
+    row = rows[-1]
+    assert row["name"] == "serve_shard_churn_100k" and "error" not in row, rows
+    assert row["churn"]["failed"] == 0, row
+    assert row["churn"]["compactions"] >= 2, row
+    # staggered: every fold names its shard; with hash-balanced writes the
+    # folds walk more than one shard across the window
+    shards_folded = row["churn"]["compaction_shards"]
+    assert len(shards_folded) == row["churn"]["compactions"], row
+    assert len(set(shards_folded)) >= 2, row
+    # zero cold compiles across the whole loaded churn window — flushes,
+    # staggered folds, publish warms, canary reranks (rehearsal-compiled)
+    assert row["churn"]["compile_s"] == 0.0, row
+    assert row["churn"]["cache_misses"] == 0, row
+    assert set(row["qps_by_shards"]) == {"1", "2"}, row
+    assert all(v > 0 for v in row["qps_by_shards"].values()), row
+    assert row["cores"] >= 1 and row["shards"] == 2, row
+    assert row["qps"] > 0 and row["write_rows_per_s"] > 0, row
+    assert row["p99_ms"] >= row["p50_ms"] > 0, row
+    # proportional sizing holds recall near the single-device oracle even
+    # at toy scale (exhaustive-ish probes)
+    assert abs(row["recall_gap"]) < 0.25, row
+    c = row["canary"]
+    assert c["reranked"] > 0 and c["seen"] > 0, row
+    assert c["wilson_low"] <= c["recall"] <= c["wilson_high"], row
+    # toy-scale bracket (a ~10-rerank reservoir): the 100k driver row
+    # asserts the strict canary.oracle_in_interval acceptance bit
+    assert abs(c["recall"] - row["recall_mut"]) < 0.35, row
+
+
+def test_serve_shard_flag_runs_only_the_shard_row(monkeypatch):
+    """`bench.py --serve-shard` is the sharded-tier iteration loop: setup
+    + the shard row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_serve_shard",
+        lambda rows: rows.append({"name": "serve_shard_churn_100k",
+                                  "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--serve-shard"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "serve_shard_churn_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()

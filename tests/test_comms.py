@@ -697,3 +697,76 @@ class TestFailurePaths:
         assert state["result"] == "cancelled"
         assert state.get("post_cancel_ok"), "token must reset after the throw"
         assert state["done"] < len(qbatches)
+
+
+class TestProgramCacheRelease:
+    """The driver program caches key on the live Comms (ISSUE 9 satellite):
+    cached programs PIN the mesh they were staged for, so a process that
+    churns mesh configs (the sharded serving tier) must be able to evict a
+    retired communicator's programs — parallel.release_programs."""
+
+    def test_hit_behavior_preserved(self, comms, rng):
+        """Same (comms, config) → the SAME program object: the Round-5
+        retrace fix survives the lru_cache → ProgramCache conversion."""
+        from raft_tpu.parallel import knn as pknn
+
+        x = rng.random((160, 8)).astype(np.float32)
+        q = rng.random((4, 8)).astype(np.float32)
+        pknn.knn(comms, x, q, k=3)
+        keys = pknn._PROGRAMS.keys_for(comms)
+        assert keys, "knn did not populate the program cache"
+        key = next(k for k in keys if k[1] == 3)
+        f1 = pknn._knn_fn(*key)
+        f2 = pknn._knn_fn(*key)
+        assert f1 is f2
+
+    def test_release_unpins_retired_comms(self, rng):
+        """Leak check: a retired mesh's Comms stays reachable while its
+        programs are cached (the leak), and is garbage the moment
+        release_programs drops them — no jax-internal reference keeps the
+        communicator alive."""
+        import gc
+        import weakref
+
+        import jax
+        from jax.sharding import Mesh
+
+        x = rng.random((64, 8)).astype(np.float32)
+        q = rng.random((4, 8)).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        c = Comms(mesh, "data")
+        d, i = parallel.knn.knn(c, x, q, k=3)
+        c.sync_stream(d, i)
+        assert len(parallel.knn._PROGRAMS.keys_for(c)) == 1
+        ref = weakref.ref(c)
+        del mesh, d, i
+        gc.collect()
+        assert ref() is not None, "sanity: cache must pin the live comms"
+        dropped = parallel.release_programs(c)
+        assert dropped == 1
+        assert parallel.knn._PROGRAMS.keys_for(c) == []
+        del c
+        gc.collect()
+        assert ref() is None, (
+            "retired comms still reachable after release_programs — a "
+            "cached program (or a new strong reference) pins the mesh")
+
+    def test_release_is_per_comms_and_bounded(self, comms, rng):
+        """release(comms) must not evict OTHER communicators' programs,
+        and the cache keeps its LRU bound."""
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.parallel import knn as pknn
+
+        x = rng.random((64, 8)).astype(np.float32)
+        q = rng.random((4, 8)).astype(np.float32)
+        pknn.knn(comms, x, q, k=3)
+        other = Comms(Mesh(np.array(jax.devices()[:2]), ("data",)), "data")
+        pknn.knn(other, x, q, k=3)
+        assert pknn._PROGRAMS.keys_for(comms)
+        parallel.release_programs(other)
+        assert pknn._PROGRAMS.keys_for(other) == []
+        assert pknn._PROGRAMS.keys_for(comms), "wrong comms was evicted"
+        assert pknn._PROGRAMS.maxsize == 256
+        assert len(pknn._PROGRAMS) <= 256
