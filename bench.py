@@ -210,6 +210,50 @@ def _obs_attach(rows, start, before):
         pass
 
 
+def _mem_snap():
+    """Ledger totals at a row-scope start (peak re-based so the scope's
+    peak is the ROW's peak), or None when metrics are disabled — the
+    disabled bench carries no "mem" field, mirroring the "obs" field."""
+    try:
+        from raft_tpu.obs import mem as obs_mem
+        from raft_tpu.obs import metrics as obs_metrics
+
+        if not obs_metrics.enabled():
+            return None
+        obs_mem.reset_peak()
+        return obs_mem.totals()
+    except Exception:
+        return None
+
+
+def _mem_attach(rows, start, before):
+    """Attach the ledger's peak device/host bytes over one guarded row
+    scope to every row it appended (ISSUE 10: BENCH rows carry memory
+    alongside QPS — the capacity half of the perf story). Peaks are the
+    scope's own (reset at _mem_snap); deltas subtract the scope-entry
+    totals, so a row that allocates and frees reports delta ~0 with a
+    real peak."""
+    if before is None:
+        return
+    try:
+        from raft_tpu.obs import mem as obs_mem
+
+        after = obs_mem.totals()
+        summary = {
+            "device_bytes": after["device_bytes"],
+            "device_peak_bytes": after["device_peak_bytes"],
+            "device_delta_bytes":
+                after["device_bytes"] - before["device_bytes"],
+            "host_bytes": after["host_bytes"],
+            "host_peak_bytes": after["host_peak_bytes"],
+            "host_delta_bytes": after["host_bytes"] - before["host_bytes"],
+        }
+        for r in rows[start:]:
+            r.setdefault("mem", summary)
+    except Exception:
+        pass
+
+
 def _recall(ids, gt):
     import numpy as np
 
@@ -1540,6 +1584,96 @@ def _row_tune_smoke(rows, n=10_000, d=64, ncl=200, n_lists=64, k=10, m=512,
     })
 
 
+def _row_mem_smoke(rows, n=100_000, d=64, n_lists=512, k=10, cycles=3):
+    """Capacity-observability proof riding the default bench (ISSUE 10):
+    ``cycles`` publish→retire cycles of same-config IVF-PQ indexes through
+    one registry, measured by the obs.mem ledger. Asserted per cycle:
+
+    - accounted device bytes return to the (baseline + one live index)
+      level after every retire + gc — the registry free path does not
+      leak (the PR 9 leak class, now a bench-gated invariant);
+    - the per-cycle ledger PEAK stays flat from cycle 2 onward (each swap
+      double-buffers old+new while warming; flat steady-state peaks = no
+      monotonic growth across swaps);
+    - cycles after the first compile NOTHING (same static config = same
+      program set; compile attribution must read 0);
+    - the retirement audit is clean after the final gc;
+    - ``obs.mem.plan()`` brackets the measured index bytes within ±20%
+      (the estimator's accuracy contract at 100k, on bench hardware).
+    """
+    import gc
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import mem as obs_mem
+    from raft_tpu.serve import IndexRegistry
+
+    assert cycles >= 2, "mem smoke needs >= 2 cycles (steady-state " \
+                        "assertions compare against the post-warmup peak)"
+    _note("mem smoke: dataset")
+    rng = np.random.default_rng(7)
+    dataset = rng.random((n, d), np.float32)
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4,
+                                pq_dim=max(min(32, d // 2), 1), seed=0)
+    reg = IndexRegistry(buckets=(1, 8, 64))
+    gc.collect()
+    baseline = obs_mem.totals()["device_bytes"]
+    peaks, levels, compile_steady = [], [], 0.0
+    measured = None
+    t0 = time.perf_counter()
+    for c in range(cycles):
+        obs_mem.reset_peak()
+        with obs_compile.attribution() as rec:
+            idx = ivf_pq.build(params, dataset)
+            jax.block_until_ready(idx.list_codes)
+            measured = int(idx.list_codes.nbytes + idx.list_ids.nbytes
+                           + idx.list_sizes.nbytes + idx.centers.nbytes
+                           + idx.centers_rot.nbytes + idx.rotation.nbytes
+                           + idx.codebooks.nbytes + idx.list_consts.nbytes
+                           + idx.list_scales.nbytes)
+            reg.publish("mem_smoke", idx, k=k)
+            del idx  # the registry version now holds the only reference
+        if c > 0:
+            compile_steady += rec.compile_s
+        gc.collect()
+        peaks.append(obs_mem.totals()["device_peak_bytes"])
+        levels.append(obs_mem.totals()["device_bytes"])
+        # one live index remains published; everything a retired cycle
+        # allocated must be gone
+        assert levels[-1] <= baseline + measured + 1024, (
+            f"cycle {c}: accounted {levels[-1]} B > baseline {baseline} + "
+            f"live index {measured} — the retire path leaked")
+    # cycle 1 starts from an empty registry; every later cycle builds the
+    # successor WHILE the predecessor is still published, so the steady
+    # state is a double-buffer peak — flat from cycle 2 onward is the
+    # no-monotonic-growth invariant
+    assert max(peaks[1:]) <= peaks[1] * 1.05 + 1024, (
+        f"per-cycle peak grew past the steady-state double-buffer: {peaks}")
+    assert compile_steady == 0.0, (
+        f"steady-state cycles compiled {compile_steady}s — same-config "
+        "publish must reuse every program")
+    audit = obs_mem.audit(collect=True)
+    assert audit["clean"], f"retirement audit: {audit['retired_unfreed']}"
+    est = obs_mem.plan("ivf_pq", params, n, d)["index_bytes"]
+    assert abs(est - measured) <= 0.20 * measured, (
+        f"plan {est} vs measured {measured} outside 20%")
+    out = reg.active("mem_smoke")  # metadata read keeps the API honest
+    rows.append({
+        "name": "mem_smoke_100k",
+        "cycles": cycles, "wall_s": round(time.perf_counter() - t0, 1),
+        "baseline_bytes": baseline, "index_bytes": measured,
+        "plan_bytes": est, "plan_ratio": round(est / measured, 3),
+        "peak_bytes_by_cycle": peaks, "level_bytes_by_cycle": levels,
+        "steady_compile_s": round(compile_steady, 3),
+        "audit_clean": audit["clean"], "published_version": out.version,
+        "mem_note": "levels = baseline + one live index per cycle; "
+                    "peaks flat across publish→retire swaps",
+    })
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -1684,6 +1818,7 @@ def _row_guard(rows, name, fn, timeout_s=None, _exit=None):
     box = {}
     start = len(rows)
     obs_before = _obs_snap()
+    mem_before = _mem_snap()
 
     def body():
         try:
@@ -1699,6 +1834,7 @@ def _row_guard(rows, name, fn, timeout_s=None, _exit=None):
         # exits the process, so a timed-out row's zombie thread can never
         # pollute a later row's delta
         _obs_attach(rows, start, obs_before)
+        _mem_attach(rows, start, mem_before)
     if t.is_alive():
         # don't shadow a success row the body already emitted under this
         # name (e.g. the flagship primary row printed before a later mode
@@ -1779,6 +1915,10 @@ def _run(rows):
 
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "tune_smoke_10k", lambda: _row_tune_smoke(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "mem_smoke_100k", lambda: _row_mem_smoke(rows))
         _emit()
 
     lid_box = {}
@@ -1871,6 +2011,13 @@ def main(argv=None):
             _setup(rows)
             _row_guard(rows, "canary_smoke_100k",
                        lambda: _row_canary_smoke(rows))
+        elif "--mem-smoke" in argv:
+            # memory-ledger loop proof only (ISSUE 10): publish→retire
+            # flat-peak + zero-leak + estimator-accuracy assertions; the
+            # regression gate over artifacts is bench/compare.py
+            _setup(rows)
+            _row_guard(rows, "mem_smoke_100k",
+                       lambda: _row_mem_smoke(rows))
         elif "--tune-smoke" in argv:
             # autotune loop proof only (ISSUE 7): the quick iteration
             # path for the tune sweep engine; heavy sweeps are

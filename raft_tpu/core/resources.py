@@ -39,14 +39,29 @@ class Resources:
       mesh: ``jax.sharding.Mesh`` for distributed algorithms; ``None`` = single
         device. Plays the role of the handle's communicator slot
         (core/resource/comms.hpp) — distributed entry points read it.
-      workspace_bytes: soft budget for temporary distance/score matrices, used
-        by batching heuristics (reference: workspace_resource +
-        chooseTileSize, knn_brute_force.cuh:78).
+      workspace_bytes: soft budget for temporary distance/score matrices,
+        honored by the XLA tiled batching heuristics (reference:
+        workspace_resource + chooseTileSize, knn_brute_force.cuh:78 —
+        here ``distance.pairwise._choose_tile``, consumed by the
+        brute-force/IVF/kmeans scan paths; the chosen tile's implied
+        workspace is observable as ``raft_tpu_mem_workspace_bytes``,
+        pinned <= this budget by test). The fused Pallas kernels size
+        their tiles from VMEM capacity instead and do NOT read it.
+      memory_budget_bytes: HARD budget for long-lived device allocations
+        (``None`` = unenforced, the default). Checked against the
+        :mod:`raft_tpu.obs.mem` ledger at ``build`` / ``serve.publish`` /
+        ``stream`` ``upsert`` admission; exceeding it raises
+        :class:`raft_tpu.serve.errors.MemoryBudgetError` (an
+        ``OverloadedError``) before any state lands. Requires obs enabled
+        at gate time — the ledger does not account under
+        ``obs.disable()``, so an armed budget there raises ``RaftError``
+        instead of silently not enforcing.
     """
 
     device: Optional[Any] = None
     mesh: Optional[jax.sharding.Mesh] = None
     workspace_bytes: int = 2 << 30
+    memory_budget_bytes: Optional[int] = None
     # Free-form registry for user extensions — the residue of the reference's
     # type-keyed resource factory map (core/resources.hpp:91-124).
     _registry: dict = dataclasses.field(default_factory=dict, repr=False)

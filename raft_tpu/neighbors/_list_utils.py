@@ -23,6 +23,16 @@ def round_up(x: int, mult: int) -> int:
     return -(-x // mult) * mult
 
 
+def list_cap_target(rows: int, n_lists: int, factor: float) -> int:
+    """The shared list-capacity policy bound (:func:`bound_capacity`):
+    lists larger than ``factor`` x the mean split, so allocated capacity
+    is at most this. ``obs.mem.plan`` sizes its IVF estimates from the
+    SAME expression — a policy change here moves both, which is what
+    keeps the estimator's ±20% contract from silently drifting."""
+    mean = max(rows / max(n_lists, 1), 1.0)
+    return round_up(max(int(mean * factor), 8), 8)
+
+
 def assign_to_lists(x, centers, metric: DistanceType, tile: int):
     """List assignment consistent with the index metric (the reference uses
     kmeans_balanced::predict with the index metric so storage placement and
@@ -155,8 +165,7 @@ def bound_capacity(labels, n_lists: int, factor: float = 1.3, x=None):
 
     sizes = jnp.bincount(labels, length=n_lists)
     max_size = max(int(jnp.max(sizes)), 1)
-    mean_size = max(labels.shape[0] / n_lists, 1.0)
-    cap_target = round_up(max(int(mean_size * factor), 8), 8)
+    cap_target = list_cap_target(labels.shape[0], n_lists, factor)
     if max_size <= cap_target:
         return labels, None, n_lists, round_up(max_size, 8), None
     # spatial splitting only for lists that shatter SEVERELY (>= 8

@@ -25,6 +25,7 @@ from ..core.resources import Resources, default_resources
 from ..distance.pairwise import _PRECISIONS, _choose_tile, _pairwise, _pad_to_tiles
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import select_k
+from ..obs import mem as obs_mem
 from ..obs.instrument import dtype_of, instrument, nrows
 
 __all__ = ["knn", "knn_merge_parts", "BruteForce"]
@@ -270,9 +271,17 @@ def knn(dataset, queries, k: int, metric="sqeuclidean", metric_arg: float = 2.0,
     if compute == "float32x3":
         compute = "float32"  # XLA fallback has no compensated mode
     # outer tile bounds the (tile, n) score block; inner tile bounds the
-    # elementwise-metric broadcast within _pairwise
+    # elementwise-metric broadcast within _pairwise. This is the
+    # Resources.workspace_bytes contract in action (the fused Pallas path
+    # above sizes from VMEM instead); the implied transient workspace is
+    # recorded so capacity planning can see it (obs.mem, pinned <= the
+    # budget by test).
     tile = _choose_tile(queries.shape[0], n, 1, res.workspace_bytes)
     inner_tile = _choose_tile(tile, n, dataset.shape[1], res.workspace_bytes)
+    obs_mem.note_workspace(
+        "brute_force.knn",
+        max(tile * n * 3 * 4,
+            inner_tile * n * (dataset.shape[1] + 2) * 4))
     return _bf_knn(dataset, queries, int(k), mt, float(metric_arg), tile, inner_tile,
                    keep_mask, approx=mode == "approx", compute=compute)
 
@@ -311,7 +320,19 @@ class BruteForce:
         self.tuned = None
 
     def build(self, dataset, res: Resources | None = None):
+        # gate BEFORE the device upload ("a refused build spends
+        # nothing"): size from the host-side view — for brute force the
+        # dataset IS the index. Stored dtype caps at 4 bytes/elt (jax
+        # downcasts f64 to f32 at asarray; byte dtypes store natively).
+        import numpy as np
+
+        arr = (dataset if hasattr(dataset, "shape")
+               and hasattr(dataset, "dtype") else np.asarray(dataset))
+        need = arr.shape[0] * arr.shape[1] * min(arr.dtype.itemsize, 4)
+        obs_mem.gate(res or default_resources(), need, site="build",
+                     detail=f"brute_force {arr.shape[0]}x{arr.shape[1]}")
         self.dataset = jnp.asarray(dataset)
+        obs_mem.account_index(self)  # ledger hook (docs/observability.md)
         return self
 
     def search(self, queries, k: int, res: Resources | None = None):

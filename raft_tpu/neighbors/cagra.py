@@ -45,6 +45,7 @@ from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scal
                               serialize_tuned)
 from ..distance.types import DistanceType, resolve_metric
 from ..obs import build as _build_metrics
+from ..obs import mem as obs_mem
 from ..obs import metrics as _metrics
 from ..obs.instrument import dtype_of, instrument, nrows
 from ..random.rng import as_key
@@ -602,6 +603,12 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
 
         kind = str(x.dtype)
         x = _as_signed(x)  # stored (and scored) in the shifted s8 domain
+    # memory-budget admission (no-op unless res.memory_budget_bytes is
+    # set): refuse BEFORE the knn-graph self-search spends anything
+    obs_mem.gate(res, lambda: obs_mem.plan(
+        "cagra", params, x.shape[0], x.shape[1],
+        dtype=kind)["index_bytes"],
+        site="build", detail=f"cagra {x.shape[0]}x{x.shape[1]}")
     t0 = time.perf_counter()
     with tracing.range("cagra.build.knn_graph"):
         knn_graph = build_knn_graph(params, x, res=res)
@@ -617,8 +624,10 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
         jax.block_until_ready(graph)
         _build_metrics.build_phase().observe(time.perf_counter() - t0,
                                  phase="cagra/optimize")
-    return CagraIndex(dataset=x, graph=graph, metric=mt, data_kind=kind,
-                      seed_pool_hint=hint)
+    out = CagraIndex(dataset=x, graph=graph, metric=mt, data_kind=kind,
+                     seed_pool_hint=hint)
+    obs_mem.account_index(out)  # ledger hook (docs/observability.md)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k
 from ..obs.instrument import dtype_of, instrument, nrows
+from ..obs import mem as obs_mem
 from ._list_utils import (assign_to_lists, bound_capacity, list_positions,
                           plan_search_tiles, round_up)
 
@@ -250,6 +251,12 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
     )
 
     kind, x, xf = _resolve_storage(params.list_dtype, x, mt)
+    # memory-budget admission (no-op unless res.memory_budget_bytes is set):
+    # refuse BEFORE the coarse trainer spends anything
+    obs_mem.gate(res, lambda: obs_mem.plan(
+        "ivf_flat", params, n, d,
+        dtype=kind if kind in ("int8", "uint8", "bfloat16") else "float32"
+    )["index_bytes"], site="build", detail=f"ivf_flat {n}x{d}")
     max_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
     train_metric = "inner_product" if mt == DistanceType.InnerProduct else "sqeuclidean"
     kb = KMeansBalancedParams(
@@ -278,6 +285,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
             split_factor=params.split_factor,
             data_kind=kind,
         )
+        obs_mem.account_index(empty)
         return empty
 
     return _extend_signed(
@@ -380,8 +388,13 @@ def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
             means = sums / jnp.maximum(sizes, 1)[:, None].astype(jnp.float32)
             child = jnp.asarray(np.repeat(spatial, rep))
             centers = jnp.where(child[:, None], means, centers)
-    return IvfFlatIndex(centers, data, idbuf, norms, sizes, index.metric, sf,
-                        index.data_kind)
+    out = IvfFlatIndex(centers, data, idbuf, norms, sizes, index.metric, sf,
+                       index.data_kind)
+    # ledger hook (docs/observability.md): the new padded lists are the
+    # long-lived allocation; the superseded index's entry auto-releases
+    # when the caller drops it
+    obs_mem.account_index(out)
+    return out
 
 
 @functools.partial(

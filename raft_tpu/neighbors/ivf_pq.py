@@ -39,6 +39,7 @@ from ..cluster.kmeans_balanced import KMeansBalancedParams
 from ..core import tracing
 from ..core.errors import expects
 from ..core.logger import logger
+from ..obs import mem as obs_mem
 from ..obs.instrument import dtype_of, instrument, nrows
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
@@ -648,6 +649,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
             "codebook_kind must be per_subspace|per_cluster|auto")
 
     data_kind, x = _resolve_pq_ingest(x, mt)
+    # memory-budget admission (no-op unless res.memory_budget_bytes is
+    # set): refuse BEFORE the coarse trainer spends anything
+    obs_mem.gate(res, lambda: obs_mem.plan(
+        "ivf_pq", params, n, d)["index_bytes"],
+        site="build", detail=f"ivf_pq {n}x{d}")
     pq_dim = params.pq_dim or _default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
     d_rot = pq_dim * pq_len
@@ -774,6 +780,7 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         data_kind=data_kind,
     )
     if not params.add_data_on_build:
+        obs_mem.account_index(index)
         return index
     # x is already the f32 working view (byte data was shifted+upcast above)
     return _extend_f32(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
@@ -927,11 +934,16 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     with tracing.range("ivf_pq.extend.fill_lists"):
         buf, idbuf, sizes, cbuf = _fill_code_lists(
             codes, new_ids, labels, n_lists, capacity, consts)
-    return dataclasses.replace(
+    out = dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
         list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
         list_scales=list_scales, split_factor=sf,
     )
+    # ledger hook (docs/observability.md): the re-packed lists are the
+    # long-lived allocation; a superseded index's entry auto-releases
+    # when its last reference drops
+    obs_mem.account_index(out)
+    return out
 
 
 @functools.partial(

@@ -23,8 +23,13 @@ control, and a bench harness that always writes structured results
 - :mod:`.requestlog` — request ids minted at admission, span timings
   through batcher → flush → lease → index search → stream merge, served
   at ``/debug/requests`` with latency-bucket exemplars.
+- :mod:`.mem` — the memory ledger: live device/host bytes attributed to
+  ``(component, name, shard, epoch)`` with weakref retirement audits
+  (leak detection on the registry/compaction/fold free paths), the
+  per-kind footprint estimator ``mem.plan()``, and the
+  ``Resources.memory_budget_bytes`` admission gate.
 - :mod:`.http` — the opt-in stdlib endpoint routing ``/metrics``,
-  ``/healthz`` and ``/debug/requests`` (404 elsewhere).
+  ``/healthz``, ``/debug/requests`` and ``/debug/mem`` (404 elsewhere).
 
 Trace annotation (the NVTX analogue) lives in :mod:`raft_tpu.core.tracing`;
 per-collective counters ride inside :mod:`raft_tpu.comms.comms`; the serving
@@ -40,6 +45,7 @@ metric catalogue.
 from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
 from . import http
+from . import mem
 from . import metrics
 from . import quality
 from . import requestlog
@@ -63,7 +69,7 @@ __all__ = [
     "stop_http_exporter", "Registry", "DEFAULT_BUCKETS", "RATIO_BUCKETS",
     "counter", "gauge", "histogram", "snapshot", "to_prometheus", "to_json",
     "delta", "quantile", "reset", "enable", "disable", "enabled",
-    "quality", "slo", "requestlog", "RecallCanary", "DriftDetector",
+    "quality", "slo", "requestlog", "mem", "RecallCanary", "DriftDetector",
     "exact_oracle", "wilson_interval", "SLOPolicy", "SLOTracker",
     "RequestLog",
 ]

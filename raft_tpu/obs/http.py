@@ -9,7 +9,12 @@ daemon-threaded stdlib ``http.server``:
   :class:`raft_tpu.obs.slo.SLOTracker` is attached, else a bare
   ``{"status": "ready"}``;
 - ``/debug/requests`` — the request-trace ring
-  (:class:`raft_tpu.obs.requestlog.RequestLog`) when one is attached.
+  (:class:`raft_tpu.obs.requestlog.RequestLog`) when one is attached;
+- ``/debug/mem`` — the memory ledger (:mod:`raft_tpu.obs.mem`): totals +
+  peaks, per-component aggregates, top allocations by
+  ``(component, name, shard, epoch)``, retirement-audit status and
+  per-device HBM stats where the backend reports them. Always routed —
+  the ledger is a process singleton, nothing to attach.
 
 Every other path is a 404 — a scrape-config typo fails loudly at
 deploy time instead of silently scraping metrics from ``/metrcs`` forever
@@ -76,6 +81,11 @@ class MetricsExporter:
                         code, body = exporter.slo.healthz()
                     self._send(code, _JSON_TYPE,
                                json.dumps(body, default=float).encode())
+                elif path == "/debug/mem":
+                    from . import mem as obs_mem
+
+                    self._send(200, _JSON_TYPE, json.dumps(
+                        obs_mem.debug_payload(), default=float).encode())
                 elif path == "/debug/requests":
                     if exporter.request_log is None:
                         self._send(404, _JSON_TYPE, json.dumps(
@@ -91,8 +101,8 @@ class MetricsExporter:
                     # silently answering a typo'd scrape config with metrics
                     self._send(404, "text/plain; charset=utf-8",
                                (f"unknown path {path!r}; endpoints: "
-                                "/metrics, /healthz, /debug/requests\n"
-                                ).encode())
+                                "/metrics, /healthz, /debug/requests, "
+                                "/debug/mem\n").encode())
 
             def log_message(self, fmt, *args):
                 # scrapes every few seconds must not spam stderr; the

@@ -30,8 +30,8 @@ docs/serving.md.
 
 from . import batcher, errors, registry, service
 from .batcher import MicroBatcher, bucket_for, bucket_sizes
-from .errors import (DeadlineExceededError, OverloadedError, ServeError,
-                     ServiceClosedError)
+from .errors import (DeadlineExceededError, MemoryBudgetError,
+                     OverloadedError, ServeError, ServiceClosedError)
 from .registry import IndexRegistry, make_searcher
 from .service import SearchService
 
@@ -40,5 +40,5 @@ __all__ = [
     "MicroBatcher", "bucket_sizes", "bucket_for",
     "IndexRegistry", "make_searcher", "SearchService",
     "ServeError", "OverloadedError", "DeadlineExceededError",
-    "ServiceClosedError",
+    "ServiceClosedError", "MemoryBudgetError",
 ]

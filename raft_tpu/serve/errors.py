@@ -12,7 +12,7 @@ from __future__ import annotations
 from ..core.errors import RaftError
 
 __all__ = ["ServeError", "OverloadedError", "DeadlineExceededError",
-           "ServiceClosedError"]
+           "ServiceClosedError", "MemoryBudgetError"]
 
 
 class ServeError(RaftError):
@@ -26,6 +26,28 @@ class OverloadedError(ServeError):
     microseconds, not after its deadline (fast-fail is the point: shed load
     at the door, never queue work that cannot be served in time).
     """
+
+
+class MemoryBudgetError(OverloadedError):
+    """The ``Resources.memory_budget_bytes`` gate refused admission: the
+    operation would push the ledger-accounted device bytes past the budget
+    (:func:`raft_tpu.obs.mem.gate`).
+
+    An :class:`OverloadedError`, so existing shed-load fences catch it, and
+    whole-or-nothing like every admission refusal: raised at
+    ``build``/``publish``/``upsert`` BEFORE any state lands. Structured
+    fields: ``site`` (which admission point), ``budget_bytes``,
+    ``accounted_bytes`` (ledger device total at refusal), ``need_bytes``
+    (the projected growth that tripped the gate).
+    """
+
+    def __init__(self, msg: str, *, site: str = "", budget_bytes: int = 0,
+                 accounted_bytes: int = 0, need_bytes: int = 0):
+        super().__init__(msg)
+        self.site = site
+        self.budget_bytes = int(budget_bytes)
+        self.accounted_bytes = int(accounted_bytes)
+        self.need_bytes = int(need_bytes)
 
 
 class DeadlineExceededError(ServeError):

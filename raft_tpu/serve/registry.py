@@ -37,6 +37,8 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..core.errors import RaftError, expects
+from ..core.resources import default_resources
+from ..obs import mem as obs_mem
 from ..obs import metrics
 
 __all__ = ["IndexRegistry", "make_searcher", "DEFAULT_BUCKETS"]
@@ -103,6 +105,10 @@ class _Version:
     active: bool = True
     leases: int = 0
     warm_report: dict = field(default_factory=dict)
+    # obs.mem ledger token (owner = the searcher closure): retired at
+    # retire-after-drain, released when the closure is actually collected
+    # — the gap between the two is the leak the retirement audit catches
+    mem: object = None
 
 
 class IndexRegistry:
@@ -126,7 +132,8 @@ class IndexRegistry:
     # -- publish / swap -----------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True, warm_data=None, tuned=None) -> dict:
+                warm: bool = True, warm_data=None, tuned=None,
+                res=None) -> dict:
         """Make ``(index, search_params)`` the active version of ``name``.
 
         Warms the searcher at every registry bucket shape for every ``k``
@@ -154,9 +161,17 @@ class IndexRegistry:
         with ``search_params`` and pre-built hooks; ``refine_ratio``
         operating points need the raw rows, so publish the hook
         ``tune.make_searcher(index, log, dataset=rows)`` builds instead.
+
+        ``res`` (a :class:`raft_tpu.core.Resources`, default the process
+        handle) carries ``memory_budget_bytes``: a publish whose index
+        would push the accounted device bytes past the budget raises
+        :class:`~raft_tpu.serve.errors.MemoryBudgetError` BEFORE the warm
+        spend and before any registry mutation — zero partial state, the
+        same whole-or-nothing contract as every admission refusal.
         """
         from .._warmup import warm_buckets
 
+        src_index = index  # the pre-resolution object, for the budget gate
         if tuned is not None:
             from ..tune.apply import make_searcher as tuned_searcher
 
@@ -178,6 +193,19 @@ class IndexRegistry:
             searcher = index
         else:
             searcher = make_searcher(index, search_params)
+        # memory-budget admission (no-op unless res.memory_budget_bytes is
+        # set): a plain index counts the device bytes the ledger has not
+        # already accounted (an obs-enabled build's bytes are in the totals
+        # the gate compares); hooks/mutables carry their bytes in their own
+        # stream/index entries and add nothing new at publish
+        obs_mem.gate(res or default_resources(),
+                     lambda: obs_mem.unaccounted_index_bytes(src_index),
+                     site="publish", detail=f"publish {name!r}")
+        # an admitted plain index joins the ledger under its serving name
+        # (idempotent — an obs-enabled build's entry just re-attributes):
+        # without this, a SECOND dark-built publish would gate against a
+        # total that never learned about the first
+        obs_mem.account_index(src_index, name=name)
         ks = (k,) if isinstance(k, int) else tuple(k)
         with self.publish_lock(name):
             # a replacement must preserve the stream contract: batchers pin
@@ -227,6 +255,11 @@ class IndexRegistry:
                 v = _Version(name, int(version), searcher,
                              self._clock(), ks=tuple(int(kk) for kk in ks),
                              warm_report=report["warm"])
+                # liveness entry for the retirement audit (bytes ride the
+                # index/stream entries; this tracks the closure that pins
+                # them — the PR 9 leak class)
+                v.mem = obs_mem.account("serve/version", name=name,
+                                        epoch=v.version, owner=searcher)
                 self._versions.setdefault(name, []).append(v)
                 self._active[name] = v
                 if old is not None:
@@ -251,6 +284,10 @@ class IndexRegistry:
             return self._publish_locks.setdefault(name, threading.RLock())
 
     def _retire(self, v: _Version) -> None:
+        # retirement audit: from here the searcher closure SHOULD become
+        # unreachable — obs.mem.audit() reports it as a leak while anything
+        # (a program cache, a stray strong ref) still pins it
+        obs_mem.retire(v.mem)
         # drop the searcher closure — it owns the only registry reference
         # to the index arrays, so this releases them to the allocator
         v.searcher = None

@@ -29,6 +29,7 @@ deadline and batching decision is then synchronous and clock-exact (the
 from __future__ import annotations
 
 import functools
+import inspect
 import threading
 import time
 from concurrent.futures import Future
@@ -156,7 +157,8 @@ class SearchService:
     # -- publish ------------------------------------------------------------
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
-                warm: bool = True, warm_data=None, tuned=None) -> dict:
+                warm: bool = True, warm_data=None, tuned=None,
+                res=None) -> dict:
         """Publish/hot-swap through the service's registry, warming against
         the SERVICE's bucket ladder (the shapes its streams actually flush).
         Safe under load: in-flight requests finish on the old version.
@@ -171,7 +173,12 @@ class SearchService:
         Publishing a :class:`raft_tpu.stream.MutableIndex` additionally
         opens the WRITE path: :meth:`upsert`/:meth:`delete` on this name
         route to it (re-publishing the index's ``searcher()`` hook — what a
-        ``stream.Compactor`` does after a swap — keeps the handle)."""
+        ``stream.Compactor`` does after a swap — keeps the handle).
+        ``res`` carries ``memory_budget_bytes`` for the publish admission
+        gate (:meth:`IndexRegistry.publish`); over budget raises
+        :class:`~raft_tpu.serve.errors.MemoryBudgetError` with zero
+        partial state — the registry is untouched and the write path
+        keeps its previous routing."""
         with tracing.range("serve/publish/%s", name):
             # hold the registry's per-name publish lock across flip AND
             # handle bookkeeping: a concurrent publish to the same name
@@ -181,7 +188,7 @@ class SearchService:
                 report = self.registry.publish(
                     name, index, search_params=search_params, k=k,
                     version=version, warm=warm, warm_data=warm_data,
-                    tuned=tuned)
+                    tuned=tuned, res=res)
                 with self._lock:
                     mut = getattr(index, "mutable", None)
                     if hasattr(index, "upsert") and hasattr(index, "searcher"):
@@ -345,7 +352,7 @@ class SearchService:
                 "write path", name)
         return m
 
-    def upsert(self, name: str, rows, ids=None):
+    def upsert(self, name: str, rows, ids=None, res=None):
         """Insert/upsert rows into the mutable index published under
         ``name``; returns the global ids. Synchronous with read-your-writes
         at the service boundary — when this returns, the rows win every
@@ -357,8 +364,29 @@ class SearchService:
         memtable raises :class:`raft_tpu.stream.DeltaFullError` — an
         :class:`OverloadedError` — so callers shed write load exactly like
         refused reads (attach a ``stream.Compactor`` to fold the delta
-        before the wall)."""
-        return self._mutable(name).upsert(rows, ids)
+        before the wall). ``res`` carries ``memory_budget_bytes``: a write
+        whose delta-bucket growth would exceed it raises
+        :class:`~raft_tpu.serve.errors.MemoryBudgetError` (also an
+        ``OverloadedError``) with nothing written. Mutables resolve
+        duck-typed, so a custom hook whose ``upsert`` takes no ``res=``
+        still writes — unless a budget is actually armed, in which case a
+        hook that cannot price it fails loudly instead of silently
+        voiding the budget."""
+        m = self._mutable(name)
+        try:
+            params = inspect.signature(m.upsert).parameters
+            takes_res = ("res" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()))
+        except (TypeError, ValueError):  # C callables: assume compatible
+            takes_res = True
+        if takes_res:
+            return m.upsert(rows, ids, res=res)
+        expects(getattr(res, "memory_budget_bytes", None) is None,
+                "memory_budget_bytes is set but the mutable published "
+                "under %r has an upsert() without res= — it cannot "
+                "enforce the budget", name)
+        return m.upsert(rows, ids)
 
     def delete(self, name: str, ids) -> int:
         """Tombstone ids on the mutable index published under ``name``;

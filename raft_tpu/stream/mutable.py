@@ -50,7 +50,9 @@ from typing import Callable
 import numpy as np
 
 from ..core.errors import RaftError, expects
+from ..core.resources import default_resources
 from ..distance.types import DistanceType, resolve_metric
+from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..serve.errors import OverloadedError
 
@@ -280,7 +282,7 @@ class _StreamState:
     __slots__ = ("cfg", "sealed", "id_map", "sealed_alive", "sealed_dead_n",
                  "store", "delta", "delta_ids", "delta_alive", "delta_n",
                  "delta_oldest_at", "epoch", "id_map_dev", "sealed_keep_dev",
-                 "delta_view", "store_dev")
+                 "delta_view", "store_dev", "mem", "__weakref__")
 
     def __init__(self, cfg: _Config):
         self.cfg = cfg
@@ -297,6 +299,11 @@ class _StreamState:
         # exact_search of an epoch (the recall canary's shadow oracle) —
         # never on the serving hot path
         self.store_dev = None
+        # obs.mem ledger token for this epoch's stream-owned arrays (delta
+        # view, masks, id map, store) — auto-releases when the state is
+        # collected, which is the retirement-audit hook for pre-compaction
+        # epochs
+        self.mem = None
 
 
 def _np_dtype(query_dtype: str):
@@ -452,14 +459,17 @@ class MutableIndex:
     inputs) to one device: the scatter mechanism of
     :class:`raft_tpu.stream.sharded.ShardedMutableIndex`, where shard ``s``
     lives on mesh device ``s`` and only candidate tuples ever leave it.
-    ``clock`` is injected for deterministic tests (the age watermark's time
-    base).
+    ``shard`` (optional) is the shard ordinal for obs.mem ledger
+    attribution — the sharded tier passes its index so ``/debug/mem``
+    breaks bytes down per shard. ``clock`` is injected for deterministic
+    tests (the age watermark's time base).
     """
 
     def __init__(self, sealed, *, search_params=None, index_params=None,
                  delta_capacity: int = 1024, retain_vectors: bool | None = None,
                  dataset=None, builder: Callable | None = None,
                  ids=None, device=None, name: str = "default",
+                 shard: int | None = None,
                  clock: Callable[[], float] = time.monotonic):
         kind, module = _resolve_kind(sealed)
         n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
@@ -492,6 +502,9 @@ class MutableIndex:
                       dim=d, data_kind=data_kind, query_dtype=query_dtype,
                       name=name, device=device)
         self._cfg = cfg
+        # shard ordinal for obs.mem ledger attribution (the sharded tier
+        # passes its shard index; None = unsharded)
+        self._shard = None if shard is None else int(shard)
         self._index_params = index_params
         expects(builder is None or callable(builder),
                 "builder must be a callable fn(rows, res=None) -> sealed index")
@@ -548,6 +561,11 @@ class MutableIndex:
         _refresh_delta(st, self.delta_capacity)
         self._state = st
         self._loc = _build_loc(st)
+        # ledger attribution: the sealed store re-attributes under the
+        # serving name (idempotent per index object); the stream-owned
+        # arrays get their own per-epoch entry
+        self._sealed_mem = obs_mem.account_index(
+            sealed, name=cfg.name, shard=self._shard, epoch=0)
         self._update_gauges(st)
 
     # -- introspection ------------------------------------------------------
@@ -620,6 +638,40 @@ class MutableIndex:
         _g_delta_fill().set(st.delta_n / self.delta_capacity, name=name)
         _g_delta_rows().set(st.delta_n, name=name)
         _g_tombstone().set(dead / max(n_sealed, 1), name=name)
+        self._account_state(st)
+
+    def _account_state(self, st: _StreamState) -> None:
+        """(Re)account this epoch's stream-owned arrays in the obs.mem
+        ledger: device = the published delta view + masks + id map (+ the
+        lazy store copy), host = the preallocated memtable, bitsets and
+        retained store. Keyed on the STATE object, so a compaction swap
+        leaves the old epoch's entry to auto-release at drain — exactly
+        what the retirement audit watches."""
+        if not metrics._enabled:
+            return
+        dev = [st.id_map_dev, st.sealed_keep_dev, *st.delta_view[:3]]
+        if st.store_dev is not None:
+            dev.append(st.store_dev)
+        host = [st.delta, st.delta_ids, st.delta_alive, st.sealed_alive,
+                st.id_map]
+        if st.store is not None:
+            host.append(st.store)
+        if st.mem is None:
+            st.mem = obs_mem.account(
+                "stream", name=self._cfg.name, shard=self._shard,
+                epoch=st.epoch, device=dev, host=host, owner=st)
+        else:
+            obs_mem.reaccount(st.mem, device=dev, host=host)
+
+    def _delta_growth_bytes(self, st: _StreamState, r: int) -> int:
+        """Device bytes a write of ``r`` rows would newly allocate: the
+        delta bucket ladder only grows in power-of-two steps, and a grown
+        bucket re-uploads rows+ids+mask (the old bucket's arrays free)."""
+        b0 = st.delta_view[3]
+        b1 = _bucket_for(st.delta_n + r, self.delta_capacity)
+        if b1 <= b0:
+            return 0
+        return (b1 - b0) * (self._cfg.dim * st.delta.dtype.itemsize + 4 + 1)
 
     # -- writes -------------------------------------------------------------
     def _coerce_rows(self, rows):
@@ -633,17 +685,24 @@ class MutableIndex:
                 self._cfg.query_dtype, rows.dtype)
         return rows
 
-    def upsert(self, rows, ids=None):
+    def upsert(self, rows, ids=None, res=None):
         """Insert rows (fresh ids assigned and returned) or upsert under
         caller-chosen ids: the previous live occurrence of each id is
         tombstoned and the new row becomes visible to the very next search
         (read-your-writes — no compaction needed). Raises
-        :class:`DeltaFullError` (an ``OverloadedError``) at capacity."""
+        :class:`DeltaFullError` (an ``OverloadedError``) at capacity, and
+        :class:`~raft_tpu.serve.errors.MemoryBudgetError` (also an
+        ``OverloadedError``) when growing the delta's device bucket would
+        exceed ``res.memory_budget_bytes`` — both BEFORE any row lands
+        (whole-or-nothing)."""
         rows = self._coerce_rows(rows)
         r = rows.shape[0]
         expects(r >= 1, "upsert needs at least one row")
         with self._lock:
             st = self._state
+            obs_mem.gate(res or default_resources(),
+                         lambda: self._delta_growth_bytes(st, r),
+                         site="upsert", detail=f"stream {self._cfg.name!r}")
             if st.delta_n + r > self.delta_capacity:
                 if metrics._enabled:
                     _c_delta_full().inc(1, name=self._cfg.name)
@@ -791,6 +850,9 @@ class MutableIndex:
         if dev is None:
             dev = _dev_put(st.cfg, st.store)
             st.store_dev = dev
+            # the lazy oracle copy joins the epoch's ledger entry (off the
+            # serving hot path by construction)
+            self._account_state(st)
         return dev
 
     def searcher(self):
@@ -1001,7 +1063,18 @@ class MutableIndex:
                 _refresh_delta(nd, self.delta_capacity)
                 # location map: every live id points at its new slot
                 self._loc = _build_loc(nd)
-                self._state = nd
+                old_state, self._state = st, nd
+                # retirement audit: the pre-compaction epoch (and, when the
+                # fold produced a successor index, the old sealed store)
+                # SHOULD free once draining leases release it — a retired
+                # entry still accounted is the leak obs.mem.audit() reports
+                obs_mem.retire(old_state.mem)
+                if nd.sealed is not old_state.sealed:
+                    old_sealed_mem = self._sealed_mem
+                    self._sealed_mem = obs_mem.account_index(
+                        nd.sealed, name=cfg.name, shard=self._shard,
+                        epoch=nd.epoch)
+                    obs_mem.retire(old_sealed_mem)
                 self._update_gauges(nd)
             return {"mode": mode, "epoch": nd.epoch,
                     "folded": int(len(d_src)), "reclaimed": int(reclaimed),

@@ -56,6 +56,7 @@ class as any read racing a write, documented in docs/streaming.md
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import threading
 import time
@@ -64,6 +65,8 @@ from typing import Callable, Sequence
 import numpy as np
 
 from ..core.errors import expects
+from ..core.resources import default_resources
+from ..obs import mem as obs_mem
 from ..obs import metrics
 from . import mutable as _mut
 from .mutable import DeltaFullError, MutableIndex
@@ -215,7 +218,7 @@ class ShardedMutableIndex:
                 dataset=None if retain_vectors is False else rows_s,
                 builder=builder, ids=gids[rows_idx],
                 device=devices[s] if devices is not None else None,
-                name=f"{name}/shard{s}", clock=clock))
+                name=f"{name}/shard{s}", shard=s, clock=clock))
         cfg0 = self._shards[0]._cfg
         for s, sh in enumerate(self._shards[1:], 1):
             expects(sh._cfg.kind == cfg0.kind and sh._cfg.dim == cfg0.dim
@@ -310,13 +313,17 @@ class ShardedMutableIndex:
         return np.concatenate([s[:cap] for s in stores])
 
     # -- writes -------------------------------------------------------------
-    def upsert(self, rows, ids=None):
+    def upsert(self, rows, ids=None, res=None):
         """Insert/upsert rows, each routed to its global id's home shard.
         Admission is checked across ALL touched shards BEFORE any row
         lands (writes go through this serialized surface, so the check is
         exact): one full home shard refuses the whole call with
-        :class:`~raft_tpu.stream.DeltaFullError` and nothing is written —
-        the same whole-or-nothing contract as a single shard's upsert."""
+        :class:`~raft_tpu.stream.DeltaFullError`, and the summed device
+        growth of every touched shard's delta bucket is checked against
+        ``res.memory_budget_bytes`` in the same hoisted pass
+        (:class:`~raft_tpu.serve.errors.MemoryBudgetError`) — either way
+        nothing is written, the same whole-or-nothing contract as a single
+        shard's upsert."""
         # validate ONCE up front (dim + dtype through shard 0's rules): a
         # per-shard refusal after a sibling already accepted its group
         # would break the whole-or-nothing contract
@@ -352,9 +359,29 @@ class ShardedMutableIndex:
                         f"/{sh.delta_capacity} rows; upsert routing "
                         f"{len(idx)} there refused — compact() (or attach "
                         "a stream.Compactor) to fold it")
+            # memory-budget admission, hoisted like the capacity check: the
+            # SUMMED bucket growth across home shards gates before any
+            # shard writes (cross-shard whole-or-nothing)
+            obs_mem.gate(
+                res or default_resources(),
+                lambda: sum(
+                    self._shards[s]._delta_growth_bytes(
+                        self._shards[s]._state, len(idx))
+                    for s, idx in enumerate(groups) if len(idx)),
+                site="upsert", detail=f"stream/sharded {self._name!r}")
+            # the hoisted pass IS the admission decision: the per-shard
+            # upserts get a budget-free res so their gates cannot refuse
+            # mid-write — a stricter ambient default, or concurrent ledger
+            # growth between the hoisted admit and shard s's write (another
+            # name's publish, an off-lock fold's double-buffer), would
+            # otherwise land a partial cross-shard write
+            inner = res or default_resources()
+            if getattr(inner, "memory_budget_bytes", None) is not None:
+                inner = dataclasses.replace(inner, memory_budget_bytes=None)
             for s, idx in enumerate(groups):
                 if len(idx):
-                    self._shards[s].upsert(rows[idx], ids=gids[idx])
+                    self._shards[s].upsert(rows[idx], ids=gids[idx],
+                                           res=inner)
             self._update_gauges()
         return gids
 

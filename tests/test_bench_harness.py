@@ -588,3 +588,189 @@ def test_serve_shard_flag_runs_only_the_shard_row(monkeypatch):
                    for r in bench._STATE["rows"])
     finally:
         bench._STATE["rows"].clear()
+
+
+def test_mem_smoke_row():
+    """The --mem-smoke bench row (ISSUE 10): publish→retire cycles with
+    flat steady-state peaks, levels returning to baseline + one live
+    index, zero steady-state compiles, a clean retirement audit, and the
+    plan-vs-measured bracket — every assertion lives IN the row body, so
+    a violation converts to an error row; here the small-scale twin must
+    come back clean."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_mem_smoke(rows, n=20_000, d=32, n_lists=128, cycles=3)
+    row = rows[-1]
+    assert row["name"] == "mem_smoke_100k" and "error" not in row, rows
+    assert row["cycles"] == 3
+    assert row["audit_clean"] is True
+    assert row["steady_compile_s"] == 0.0
+    assert 0.8 <= row["plan_ratio"] <= 1.2, row
+    assert len(row["peak_bytes_by_cycle"]) == 3
+    # levels flat: every cycle ends at baseline + exactly one live index
+    lv = row["level_bytes_by_cycle"]
+    assert max(lv) - min(lv) == 0, row
+
+
+def test_mem_smoke_flag_runs_only_the_mem_row(monkeypatch):
+    """`bench.py --mem-smoke` is the memory-ledger iteration loop: setup
+    + the mem row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_mem_smoke",
+        lambda rows: rows.append({"name": "mem_smoke_100k",
+                                  "audit_clean": True}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--mem-smoke"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "mem_smoke_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()
+
+
+def test_rows_carry_mem_field(monkeypatch):
+    """Every guarded row scope attaches a "mem" field (peak device/host
+    bytes via the ledger) when metrics are on, and none when disabled —
+    the same contract as the "obs" attribution field."""
+    import bench
+    from raft_tpu import obs
+    from raft_tpu.obs import mem as obs_mem
+
+    rows = []
+
+    def body():
+        t = obs_mem.account("bench_probe", device_bytes=4096)
+        obs_mem.release(t)
+        rows.append({"name": "probe_row", "qps": 1.0})
+
+    bench._row_guard(rows, "probe_row", body)
+    row = next(r for r in rows if r["name"] == "probe_row")
+    assert "mem" in row, row
+    assert row["mem"]["device_peak_bytes"] >= (
+        row["mem"]["device_bytes"])
+    assert row["mem"]["device_peak_bytes"] - row["mem"]["device_bytes"] \
+        >= 4096, "the scope peak must see the transient allocation"
+
+    obs.disable()
+    try:
+        rows2 = []
+        bench._row_guard(rows2, "probe_row2",
+                         lambda: rows2.append({"name": "probe_row2"}))
+        assert "mem" not in rows2[0], rows2
+    finally:
+        obs.enable()
+
+
+# ---------------------------------------------------------------------------
+# bench/compare.py — the artifact regression gate (ISSUE 10 satellite)
+# ---------------------------------------------------------------------------
+
+def _artifact(rows):
+    return {"parsed": {"metric": "m", "value": 1.0, "rows": rows}}
+
+
+def test_compare_passes_on_identical_artifacts():
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    art = _artifact([{"name": "a", "qps": 100.0, "recall": 0.9}])
+    out = compare.compare(art, art)
+    assert out["regressions"] == []
+    assert out["rows"][0]["status"] == "ok"
+
+
+def test_compare_flags_qps_and_recall_regressions():
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([
+        {"name": "a", "qps": 100.0, "recall": 0.90},
+        {"name": "b", "qps": 100.0, "recall_mut": 0.90},
+        {"name": "c", "qps": 100.0},
+        {"name": "gone", "qps": 5.0},
+    ])
+    new = _artifact([
+        {"name": "a", "qps": 80.0, "recall": 0.90},     # -20% QPS
+        {"name": "b", "qps": 99.0, "recall_mut": 0.85},  # -0.05 recall
+        {"name": "c", "error": "boom"},                  # new error row
+        {"name": "fresh", "qps": 1.0},
+    ])
+    out = compare.compare(old, new, qps_tol=0.15, recall_tol=0.01)
+    assert sorted(out["regressions"]) == ["a", "b", "c"]
+    assert out["only_old"] == ["gone"] and out["only_new"] == ["fresh"]
+    # within tolerance → no gate
+    ok = compare.compare(old, _artifact([
+        {"name": "a", "qps": 90.0, "recall": 0.895},
+        {"name": "b", "qps": 100.0, "recall_mut": 0.90},
+        {"name": "c", "qps": 100.0},
+        {"name": "gone", "qps": 5.0},
+    ]), qps_tol=0.15, recall_tol=0.01)
+    assert ok["regressions"] == []
+
+
+def test_compare_gates_on_lost_measurements():
+    """Review regression: a QPS/recall field present in the old row but
+    missing from the new is a gate failure, not a silent skip — a harness
+    bug that drops the measurement must not pass as 'ok'."""
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([
+        {"name": "a", "qps": 100.0, "recall": 0.90},
+        {"name": "b", "qps": 100.0, "recall_mut": 0.90},
+    ])
+    new = _artifact([
+        {"name": "a", "qps": 100.0},                    # recall vanished
+        {"name": "b", "recall_mut": 0.90},              # qps vanished
+    ])
+    out = compare.compare(old, new)
+    assert sorted(out["regressions"]) == ["a", "b"]
+    missing = {(r["name"], c["field"]) for r in out["rows"]
+               for c in r["checks"] if c.get("missing")}
+    assert missing == {("a", "recall"), ("b", "qps")}
+    # a field the NEW artifact gained gates nothing (new rows/fields
+    # appear every round)
+    ok = compare.compare(new, old)
+    assert ok["regressions"] == []
+
+
+def test_compare_table_and_exit_codes(tmp_path, capsys):
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([{"name": "a", "qps": 100.0, "recall": 0.9}])
+    bad = _artifact([{"name": "a", "qps": 10.0, "recall": 0.9}])
+    po, pb = tmp_path / "old.json", tmp_path / "bad.json"
+    po.write_text(json.dumps(old))
+    pb.write_text(json.dumps(bad))
+    assert compare.main([str(po), str(po), "--table"]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out and "REGRESSION" not in out
+    assert compare.main([str(po), str(pb), "--table"]) == 1
+    out = capsys.readouterr().out
+    assert "**REGRESSION**" in out and "FAIL: a" in out
+
+
+def test_compare_bench_r05_vs_itself_passes():
+    """The committed BENCH_r05 artifact compared against itself passes
+    the gate (the ISSUE 10 acceptance bar for the tool's IO path: real
+    driver wrapper, real row vocabulary, rc 0)."""
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    art = json.loads((REPO / "BENCH_r05.json").read_text())
+    out = compare.compare(art, art)
+    assert out["regressions"] == []
+    assert len(out["rows"]) >= 5  # the artifact's named rows all matched
+    assert compare.main([str(REPO / "BENCH_r05.json"),
+                         str(REPO / "BENCH_r05.json")]) == 0
