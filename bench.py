@@ -95,6 +95,28 @@ archives per round:
                                  row; the full sweeps write TUNE_rXX.json
                                  via bench/tune_sweep.py. `--tune-smoke`
                                  runs ONLY this row.
+  fault_smoke_100k               availability proof (ISSUE 11): a sharded
+                                 mesh with per-shard replica groups serves
+                                 a loaded window during which one replica
+                                 is killed (fault-injected) and later
+                                 revived — zero failed queries (same-flush
+                                 failover to the surviving twin), the
+                                 victim actually fenced (strikes > 0) and
+                                 healed through the backoff re-probe
+                                 (recovery_s), zero cold compiles across
+                                 the fence/failover/probe window
+                                 (rehearsal-warmed). `--fault-smoke` runs
+                                 ONLY the fault rows.
+  crash_recovery_100k            crash-durability proof (ISSUE 11): a 100k
+                                 MutableIndex with a write-ahead log takes
+                                 an un-compacted write burst, "dies" via a
+                                 SimulatedCrash between WAL append and
+                                 memtable insert, and recovers through
+                                 stream.load(wal=) + replay + warm() —
+                                 recall_recovered == 1.0 vs an uncrashed
+                                 twin (gated by bench/compare.py),
+                                 recovery_s + replay_rows_per_s recorded,
+                                 zero cold compiles post-warm.
   ivf_flat_1m_p8                 IVF-Flat on the isotropic clustered 1M set
   cagra_1m_itopk32               CAGRA on the same set
 
@@ -1674,6 +1696,256 @@ def _row_mem_smoke(rows, n=100_000, d=64, n_lists=512, k=10, cycles=3):
     })
 
 
+def _row_fault_smoke(rows, n=100_000, d=64, n_lists=512, k=10,
+                     n_probes=16, shards=2, replicas=2, steps=160,
+                     qbatch=64, fence_at=40, heal_at=110,
+                     write_every=10, write_rows=16, delta_capacity=2048):
+    """Availability proof riding the default bench (ISSUE 11): a sharded
+    mesh with per-shard replica groups serves a loaded window during which
+    one replica is killed outright (fault-injected search failures) and
+    later revived. Asserted:
+
+    - **zero failed queries**: every batch in the window answers — the
+      scatter retries the surviving twin in the same call (one dead
+      replica = degraded capacity, never a failed query);
+    - the dead replica is actually FENCED (breaker strikes observed) and,
+      after the fault clears, HEALS through the backoff re-probe —
+      ``recovery_s`` records fault-cleared → all replicas serving again;
+    - **zero cold compiles** across the measured window, fence, failover
+      retries, probes and writes included — rehearsal protocol: the same
+      schedule replays unmeasured first, then obs compile attribution
+      must read 0 over the measured pass;
+    - writes keep applying to the fenced replica (fenced-for-READS is not
+      stale: it missed nothing) so the heal needs no rebuild.
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.testing import faults
+
+    assert fence_at < heal_at < steps
+    _note("fault smoke: dataset")
+    rng = np.random.default_rng(11)
+    x = rng.random((n, d), np.float32)
+    pool = rng.random((1024, d), np.float32)
+    churn = rng.random((steps * write_rows, d), np.float32)
+    nl = max(n_lists // shards, 8)
+    sp = ivf_flat.SearchParams(n_probes=max(n_probes // shards, 1))
+
+    def run_window(sm):
+        """The deterministic schedule: searches + light writes; at
+        fence_at the replica shard 0 currently PREFERS (lowest scan-wall
+        EWMA, breaker closed — the one `_pick` returns next) is killed,
+        revived at heal_at. Killing the preferred twin, not a fixed
+        ordinal, is what makes the strike deterministic: the next scatter
+        is guaranteed to pick it, strike it, and fail over."""
+        failed, t_heal, recovery_s = 0, None, None
+        t0 = time.perf_counter()
+        try:
+            for i in range(steps):
+                if i == fence_at:
+                    grp = sm._shards[0]
+                    with grp._lock:
+                        j = min((jj for jj, h in enumerate(grp._health)
+                                 if h.fenced_until is None and not h.stale),
+                                key=lambda jj: grp._health[jj].ewma or 0.0)
+                    victim = grp._replicas[j].name
+                    faults.inject(
+                        "replica/search", exc=faults.FaultError("killed"),
+                        match=lambda c, v=victim: c["replica"] == v)
+                if i == heal_at:
+                    faults.clear("replica/search")
+                    t_heal = time.perf_counter()
+                q = pool[(i * qbatch) % 960:(i * qbatch) % 960 + qbatch]
+                try:
+                    dq, iq = sm.search(q, k)
+                    assert np.asarray(iq).shape == (qbatch, k)
+                except Exception:
+                    failed += 1
+                if write_every and i % write_every == 0:
+                    sm.upsert(churn[i * write_rows:(i + 1) * write_rows])
+                if (t_heal is not None and recovery_s is None
+                        and sm.health()["healthy_min"] == replicas):
+                    recovery_s = time.perf_counter() - t_heal
+        finally:
+            faults.clear("replica/search")
+        # drain the fence if the loop ended before the probe window
+        while recovery_s is None:
+            sm.search(pool[:qbatch], k)
+            if sm.health()["healthy_min"] == replicas:
+                recovery_s = time.perf_counter() - t_heal
+        return {"failed": failed, "recovery_s": recovery_s,
+                "wall_s": time.perf_counter() - t0}
+
+    def make_mesh(name):
+        sm = stream.ShardedMutableIndex(
+            x, n_shards=shards, replicas=replicas,
+            build=lambda r: ivf_flat.build(
+                ivf_flat.IndexParams(n_lists=nl, seed=0), r),
+            search_params=sp, delta_capacity=delta_capacity,
+            fencing=stream.FencingPolicy(max_consecutive=2,
+                                         backoff_s=0.05,
+                                         backoff_max_s=0.5),
+            name=name)
+        sm.warm((qbatch,), ks=(k,))
+        jax.block_until_ready(sm.search(pool[:qbatch], k))  # sealed side
+        return sm
+
+    _note("fault smoke: rehearsal")
+    rehearsal = make_mesh("fault_rehearsal")
+    run_window(rehearsal)
+    del rehearsal
+
+    _note("fault smoke: measured window")
+    mesh = make_mesh("fault")
+    with obs_compile.attribution() as rec:
+        out = run_window(mesh)
+    strikes = sum(h.strikes for h in mesh._shards[0]._health)
+    assert out["failed"] == 0, (
+        f"{out['failed']} queries failed during the fence window — the "
+        "failover contract is zero failed queries")
+    assert strikes > 0, "the victim replica was never struck — the fault " \
+                        "window did not exercise failover"
+    assert rec.compile_s == 0.0, (
+        f"loaded window compiled {rec.compile_s}s after rehearsal — "
+        "failover/probe paths minted a new program")
+    rows.append({
+        "name": "fault_smoke_100k", "n": n, "shards": shards,
+        "replicas": replicas, "queries": steps,
+        "failed_queries": out["failed"], "strikes": strikes,
+        "recovery_s": round(out["recovery_s"], 3),
+        "qps": round(steps * qbatch / out["wall_s"], 1),
+        "compile_s_loaded": rec.compile_s,
+        "wall_s": round(out["wall_s"], 1),
+        "fault_note": "one replica killed mid-load and revived; zero "
+                      "failed queries, zero cold compiles; recovery_s = "
+                      "fault cleared -> every replica serving",
+    })
+
+
+def _row_crash_recovery(rows, n=100_000, d=64, n_lists=512, k=10,
+                        n_probes=16, write_steps=40, write_rows=64,
+                        delete_rows=8, delta_capacity=4096, n_eval=256):
+    """Crash-durability proof riding the default bench (ISSUE 11): a
+    100k MutableIndex with a write-ahead log takes ``write_steps``
+    un-compacted upsert+delete batches, then the process "dies" — a
+    :class:`~raft_tpu.testing.faults.SimulatedCrash` injected between the
+    WAL append and the memtable insert of the final write, after which
+    the in-memory object is abandoned. Recovery is the real cold-start
+    path: ``stream.load(snapshot, wal=)`` (atomic snapshot + WAL replay)
+    + ``warm()``. Asserted and recorded:
+
+    - **every logged write is recovered** — an uncrashed twin replays the
+      identical write script in-process and the recovered index matches
+      it id-for-id over ``n_eval`` queries (``recall_recovered`` = match
+      fraction, gated at 1.0 by bench/compare.py like every recall
+      field);
+    - ``recovery_s`` (load + replay wall), ``replay_rows_per_s`` and the
+      WAL's size/record count ride the artifact — the measured price of
+      crash durability at 100k;
+    - **zero cold compiles** on the post-warm serving window (compile
+      attribution over a query loop after ``warm()``).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.testing import faults
+
+    _note("crash recovery: dataset + sealed build")
+    rng = np.random.default_rng(13)
+    x = rng.random((n, d), np.float32)
+    churn = rng.random((write_steps * write_rows, d), np.float32)
+    eval_q = rng.random((n_eval, d), np.float32)
+    sealed = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists, seed=0), x)
+    jax.block_until_ready(sealed.list_data)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+
+    tmp = tempfile.mkdtemp(prefix="raft_crash_")
+    try:
+        snap = os.path.join(tmp, "snap.bin")
+        wpath = os.path.join(tmp, "wal.log")
+
+        def write_script(m):
+            """The acknowledged writes (deterministic — the twin replays it)."""
+            for s in range(write_steps - 1):
+                m.upsert(churn[s * write_rows:(s + 1) * write_rows])
+                m.delete(list(range(s * delete_rows, (s + 1) * delete_rows)))
+            return churn[(write_steps - 1) * write_rows:]
+
+        _note("crash recovery: write burst + injected crash")
+        m = stream.MutableIndex(sealed, search_params=sp,
+                                delta_capacity=delta_capacity, wal=wpath)
+        stream.save(m, snap)  # the pre-burst snapshot (atomic)
+        last_batch = write_script(m)
+        wal_bytes = m._wal.size_bytes
+        with faults.scope():
+            faults.inject("stream/post-wal", faults.SimulatedCrash("kill -9"))
+            try:
+                m.upsert(last_batch)
+                raise AssertionError("crash fault never fired")
+            except faults.SimulatedCrash:
+                pass
+        replayable = write_steps * write_rows  # every LOGGED upsert row
+        del m  # the process is gone; snap + wal.log are all that survive
+
+        _note("crash recovery: load + WAL replay")
+        t0 = time.perf_counter()
+        rec = stream.load(snap, wal=wpath, search_params=sp)
+        recovery_s = time.perf_counter() - t0
+        assert rec.last_recovery["replayed"] == 2 * (write_steps - 1) + 1, (
+            f"replay applied {rec.last_recovery['replayed']} records, "
+            f"expected every logged write")
+        t0 = time.perf_counter()
+        rec.warm((n_eval,), ks=(k,))
+        warm_s = time.perf_counter() - t0
+        jax.block_until_ready(rec.search(eval_q, k))  # sealed-side rehearsal
+        with obs_compile.attribution() as att:
+            for _ in range(3):
+                dr, ir = rec.search(eval_q, k)
+            jax.block_until_ready((dr, ir))
+        assert att.compile_s == 0.0, (
+            f"post-warm serving compiled {att.compile_s}s — the recovered "
+            "cold-start path must be compile-free after warm()")
+
+        _note("crash recovery: uncrashed twin parity")
+        twin = stream.MutableIndex(sealed, search_params=sp,
+                                   delta_capacity=delta_capacity)
+        last = write_script(twin)
+        twin.upsert(last)  # the crashed write WAS logged, so replay applies it
+        dt, it = twin.search(eval_q, k)
+        ids_match = float(np.mean(np.asarray(ir) == np.asarray(it)))
+        assert rec.size == twin.size, (rec.size, twin.size)
+        assert ids_match == 1.0, (
+            f"recovered index diverges from the uncrashed twin "
+            f"(id match {ids_match:.4f}) — an acknowledged write was lost")
+        rows.append({
+            "name": "crash_recovery_100k", "n": n,
+            "wal_records": rec.last_recovery["replayed"],
+            "wal_bytes": wal_bytes,
+            "recovered_rows": replayable,
+            "recall_recovered": ids_match,  # gated by bench/compare.py
+            "recovery_s": round(recovery_s, 3),
+            "warm_s": round(warm_s, 3),
+            "replay_rows_per_s": round(replayable / recovery_s, 1),
+            "compile_s_post_warm": att.compile_s,
+            "crash_note": "SimulatedCrash between WAL append and memtable "
+                          "insert of the final write; recovery = atomic "
+                          "snapshot + replay of every logged record",
+        })
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -1921,6 +2193,16 @@ def _run(rows):
         _row_guard(rows, "mem_smoke_100k", lambda: _row_mem_smoke(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "fault_smoke_100k",
+                   lambda: _row_fault_smoke(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "crash_recovery_100k",
+                   lambda: _row_crash_recovery(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -2018,6 +2300,15 @@ def main(argv=None):
             _setup(rows)
             _row_guard(rows, "mem_smoke_100k",
                        lambda: _row_mem_smoke(rows))
+        elif "--fault-smoke" in argv:
+            # availability loop only (ISSUE 11): replica kill + same-flush
+            # failover + breaker heal, then the injected-crash WAL-replay
+            # recovery row — the iteration path for fencing/WAL parameters
+            _setup(rows)
+            _row_guard(rows, "fault_smoke_100k",
+                       lambda: _row_fault_smoke(rows))
+            _row_guard(rows, "crash_recovery_100k",
+                       lambda: _row_crash_recovery(rows))
         elif "--tune-smoke" in argv:
             # autotune loop proof only (ISSUE 7): the quick iteration
             # path for the tune sweep engine; heavy sweeps are

@@ -10,8 +10,10 @@ Index classes serialize as a sequence of scalars + ``.npy`` blocks in one file.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
+import os
 import struct
 from typing import Any, BinaryIO
 
@@ -30,6 +32,7 @@ __all__ = [
     "serialize_tuned",
     "deserialize_tuned",
     "version_number",
+    "atomic_write", "fsync_dir",
     "SERIALIZATION_VERSION",
 ]
 
@@ -53,7 +56,11 @@ __all__ = [
 #       record (bool has_tuned + JSON decision, raft_tpu.tune — the pinned
 #       operating point rides WITH the index, provenance inline); absent on
 #       untuned indexes, skipped cleanly by the /8 layouts.
-SERIALIZATION_VERSION = "raft_tpu/9"
+#   raft_tpu/10: the "stream" section carries wal_seq (the write-ahead-log
+#       sequence the snapshot covers — raft_tpu.stream.wal replays only
+#       records past it at load); ivf_flat/ivf_pq/cagra/brute_force
+#       layouts are unchanged from /9.
+SERIALIZATION_VERSION = "raft_tpu/10"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
@@ -63,14 +70,15 @@ SERIALIZATION_VERSION = "raft_tpu/9"
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                            "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                           "raft_tpu/8"}),
+                           "raft_tpu/8", "raft_tpu/9"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
-                         "raft_tpu/6", "raft_tpu/7", "raft_tpu/8"}),
+                         "raft_tpu/6", "raft_tpu/7", "raft_tpu/8",
+                         "raft_tpu/9"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                         "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                        "raft_tpu/8"}),
-    "stream": frozenset({"raft_tpu/8"}),
-    "brute_force": frozenset({"raft_tpu/8"}),
+                        "raft_tpu/8", "raft_tpu/9"}),
+    "stream": frozenset({"raft_tpu/8", "raft_tpu/9"}),
+    "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9"}),
 }
 
 
@@ -203,3 +211,57 @@ def deserialize_tuned(fp: BinaryIO, ver: str) -> dict | None:
     if not deserialize_scalar(fp):
         return None
     return deserialize_json(fp)
+
+
+def fsync_dir(dirname: str) -> None:
+    """fsync a directory so a just-renamed/created entry survives a
+    machine crash (no-op where directories cannot be opened, e.g.
+    Windows — there ``os.replace`` is already metadata-atomic)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_write(path: str):
+    """Crash-safe snapshot writes: yields a binary file handle onto a
+    same-directory temp file, and only on clean exit fsyncs and
+    ``os.replace``\\ s it over ``path`` — a crash (or raise) mid-write
+    leaves the previous file byte-identical instead of half-overwritten.
+    Every index/stream ``save()`` goes through this; the
+    ``serialize/atomic-write`` fault point sits between the temp write and
+    the rename so tests can prove the crash window
+    (:mod:`raft_tpu.testing.faults`)."""
+    from ..testing import faults
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    f = open(tmp, "wb")
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        # the crash window: temp file complete, rename not yet done — the
+        # previous snapshot must still load
+        faults.fire("serialize/atomic-write", path=path, tmp=tmp)
+        os.replace(tmp, path)
+        # the rename itself is only durable once the DIRECTORY entry is on
+        # disk — without this a machine crash can surface the old snapshot
+        # after a WAL truncation that assumed the new one, losing
+        # acknowledged writes (the one ordering the WAL contract forbids)
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+    except BaseException:
+        if not f.closed:
+            f.close()
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

@@ -374,7 +374,11 @@ def read_index(f) -> BruteForce:
 
 
 def save(index: BruteForce, path: str) -> None:
-    with open(path, "wb") as f:
+    """Serialize atomically (temp file + rename — a crashed save leaves
+    the previous file readable; :func:`core.serialize.atomic_write`)."""
+    from ..core.serialize import atomic_write
+
+    with atomic_write(path) as f:
         write_index(f, index)
 
 

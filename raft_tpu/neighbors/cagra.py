@@ -967,8 +967,11 @@ def read_index(f) -> CagraIndex:
 
 
 def save(index: CagraIndex, path: str) -> None:
-    """Serialize (reference: cagra_serialize.cuh)."""
-    with open(path, "wb") as f:
+    """Serialize (reference: cagra_serialize.cuh).
+    Atomic: temp file + rename, a crashed save keeps the previous file."""
+    from ..core.serialize import atomic_write
+
+    with atomic_write(path) as f:
         write_index(f, index)
 
 

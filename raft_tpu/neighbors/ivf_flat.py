@@ -578,8 +578,11 @@ def read_index(f) -> IvfFlatIndex:
 
 
 def save(index: IvfFlatIndex, path: str) -> None:
-    """Serialize (reference: ivf_flat_serialize.cuh; pylibraft save)."""
-    with open(path, "wb") as f:
+    """Serialize (reference: ivf_flat_serialize.cuh; pylibraft save).
+    Atomic: temp file + rename, a crashed save keeps the previous file."""
+    from ..core.serialize import atomic_write
+
+    with atomic_write(path) as f:
         write_index(f, index)
 
 

@@ -1473,8 +1473,11 @@ def read_index(f) -> IvfPqIndex:
 
 
 def save(index: IvfPqIndex, path: str) -> None:
-    """Serialize (reference: ivf_pq_serialize.cuh:52-110)."""
-    with open(path, "wb") as f:
+    """Serialize (reference: ivf_pq_serialize.cuh:52-110).
+    Atomic: temp file + rename, a crashed save keeps the previous file."""
+    from ..core.serialize import atomic_write
+
+    with atomic_write(path) as f:
         write_index(f, index)
 
 

@@ -7,7 +7,11 @@ daemon-threaded stdlib ``http.server``:
 - ``/healthz`` — the SLO verdict (ready/degraded/failing as JSON; 503 on
   failing so load balancers eject the replica) when an
   :class:`raft_tpu.obs.slo.SLOTracker` is attached, else a bare
-  ``{"status": "ready"}``;
+  ``{"status": "ready"}``; with ``replicas=`` attached (a
+  :class:`~raft_tpu.stream.ReplicatedShard` /
+  :class:`~raft_tpu.stream.ShardedMutableIndex`), per-replica breaker
+  health folds into the verdict — fenced twins degrade, a group at zero
+  pickable twins fails;
 - ``/debug/requests`` — the request-trace ring
   (:class:`raft_tpu.obs.requestlog.RequestLog`) when one is attached;
 - ``/debug/mem`` — the memory ledger (:mod:`raft_tpu.obs.mem`): totals +
@@ -49,6 +53,25 @@ _lock = threading.Lock()
 _active: "MetricsExporter | None" = None
 
 
+def _fold_replica_health(code: int, body: dict, h: dict) -> tuple[int, dict]:
+    """Merge a replica-health payload (:meth:`ReplicatedShard.health` or
+    :meth:`ShardedMutableIndex.health`) into the ``/healthz`` verdict: a
+    group with ZERO pickable twins fails queries — that is an outage
+    (``failing``/503, load balancers eject the process); fenced-but-
+    surviving twins degrade a ``ready`` verdict (capacity is down, data
+    is not)."""
+    groups = h["shards"] if "shards" in h else [h]
+    body["replicas"] = h
+    healthy_min = min((g["healthy"] for g in groups), default=1)
+    fenced = sum(1 for g in groups
+                 for r in g.get("replicas", []) if r["fenced"])
+    if healthy_min == 0:
+        return 503, dict(body, status="failing")
+    if fenced and body.get("status") == "ready":
+        body["status"] = "degraded"
+    return code, body
+
+
 class MetricsExporter:
     """One running exporter: a ThreadingHTTPServer on a daemon thread.
     ``slo``/``request_log`` are optional sources for ``/healthz`` and
@@ -56,7 +79,7 @@ class MetricsExporter:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: metrics.Registry | None = None,
-                 slo=None, request_log=None):
+                 slo=None, request_log=None, replicas=None):
         reg = registry or metrics.default_registry()
         exporter = self
 
@@ -79,6 +102,9 @@ class MetricsExporter:
                                            "note": "no SLO tracker attached"}
                     else:
                         code, body = exporter.slo.healthz()
+                    if exporter.replicas is not None:
+                        code, body = _fold_replica_health(
+                            code, dict(body), exporter.replicas.health())
                     self._send(code, _JSON_TYPE,
                                json.dumps(body, default=float).encode())
                 elif path == "/debug/mem":
@@ -111,6 +137,7 @@ class MetricsExporter:
 
         self.slo = slo
         self.request_log = request_log
+        self.replicas = replicas
         self._server = ThreadingHTTPServer((host, int(port)), Handler)
         self._server.daemon_threads = True
         self.host = host
@@ -138,13 +165,18 @@ class MetricsExporter:
 
 def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
                         registry: metrics.Registry | None = None,
-                        slo=None, request_log=None) -> MetricsExporter:
+                        slo=None, request_log=None,
+                        replicas=None) -> MetricsExporter:
     """Start (or return the already-running) obs HTTP endpoint.
 
     ``port=0`` binds an ephemeral port (read it off the returned
     ``.port``); ``host`` defaults to loopback — bind "0.0.0.0" explicitly
     to expose beyond the machine. ``slo=``/``request_log=`` attach the
-    ``/healthz`` and ``/debug/requests`` sources. One exporter per process
+    ``/healthz`` and ``/debug/requests`` sources; ``replicas=`` (a
+    :class:`~raft_tpu.stream.ReplicatedShard` or
+    :class:`~raft_tpu.stream.ShardedMutableIndex`) folds per-replica
+    breaker health into the ``/healthz`` verdict — any group at zero
+    pickable twins is ``failing``/503. One exporter per process
     through this module-level entry (a second call returns the live one —
     attach sources on the first call); construct :class:`MetricsExporter`
     directly for multiples or custom registries.
@@ -154,7 +186,8 @@ def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
         if _active is not None:
             return _active
         _active = MetricsExporter(port=port, host=host, registry=registry,
-                                  slo=slo, request_log=request_log)
+                                  slo=slo, request_log=request_log,
+                                  replicas=replicas)
         return _active
 
 

@@ -28,17 +28,20 @@ feeds, and request-level tracing (``raft_tpu.obs.quality`` /
 docs/serving.md.
 """
 
-from . import batcher, errors, registry, service
+from . import batcher, errors, registry, retry, service
 from .batcher import MicroBatcher, bucket_for, bucket_sizes
 from .errors import (DeadlineExceededError, MemoryBudgetError,
-                     OverloadedError, ServeError, ServiceClosedError)
+                     OverloadedError, ReplicaUnavailableError, ServeError,
+                     ServiceClosedError)
 from .registry import IndexRegistry, make_searcher
+from .retry import submit_with_retry
 from .service import SearchService
 
 __all__ = [
-    "batcher", "registry", "service", "errors",
+    "batcher", "registry", "service", "errors", "retry",
     "MicroBatcher", "bucket_sizes", "bucket_for",
     "IndexRegistry", "make_searcher", "SearchService",
+    "submit_with_retry",
     "ServeError", "OverloadedError", "DeadlineExceededError",
-    "ServiceClosedError", "MemoryBudgetError",
+    "ServiceClosedError", "MemoryBudgetError", "ReplicaUnavailableError",
 ]

@@ -12,7 +12,8 @@ from __future__ import annotations
 from ..core.errors import RaftError
 
 __all__ = ["ServeError", "OverloadedError", "DeadlineExceededError",
-           "ServiceClosedError", "MemoryBudgetError"]
+           "ServiceClosedError", "MemoryBudgetError",
+           "ReplicaUnavailableError"]
 
 
 class ServeError(RaftError):
@@ -48,6 +49,23 @@ class MemoryBudgetError(OverloadedError):
         self.budget_bytes = int(budget_bytes)
         self.accounted_bytes = int(accounted_bytes)
         self.need_bytes = int(need_bytes)
+
+
+class ReplicaUnavailableError(ServeError):
+    """EVERY replica of a :class:`raft_tpu.stream.ReplicatedShard` is
+    fenced or failed — the query cannot be served by any twin. One dead
+    replica never raises this (the scatter retries the survivor in the
+    same flush, which is the availability contract); all-dead is a real
+    outage the caller must see. Structured fields: ``name`` (the shard),
+    ``replicas`` (total), ``fenced`` (how many were fenced when the last
+    attempt failed)."""
+
+    def __init__(self, msg: str, *, name: str = "", replicas: int = 0,
+                 fenced: int = 0):
+        super().__init__(msg)
+        self.name = name
+        self.replicas = int(replicas)
+        self.fenced = int(fenced)
 
 
 class DeadlineExceededError(ServeError):

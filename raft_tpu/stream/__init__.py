@@ -18,29 +18,53 @@ fresh/sealed split of FreshDiskANN (Singh et al. 2021; PAPERS.md):
   :class:`raft_tpu.serve.IndexRegistry` so the serving hot path never sees
   a cold program and in-flight leases drain on the old epoch.
 - :func:`save`/:func:`load` — the full mutable state (sealed + delta +
-  tombstones + id map) as one ``stream`` file section (raft_tpu/8).
+  tombstones + id map) as one ``stream`` file section (raft_tpu/10),
+  written ATOMICALLY (temp file + rename — a crash mid-save keeps the
+  previous snapshot) and stamped with the WAL sequence it covers;
+  ``load(wal=)`` replays acknowledged writes past the snapshot — the
+  crash-recovery path.
+- :class:`~raft_tpu.stream.wal.WriteAheadLog` — append-only checksummed
+  log of every upsert/delete, written at admission BEFORE the memtable
+  (``MutableIndex(wal=)``), fsync-batched, truncated at each snapshot.
+  A killed process loses no acknowledged write.
+- :class:`ReplicatedShard` — R device-pinned MutableIndex twins behind
+  one surface: writes apply to all live twins (whole-or-nothing
+  admission), reads fan to ONE picked by health + latency EWMA with
+  same-call failover, and a failed/slow twin is fenced by a
+  consecutive-strike circuit breaker with doubling-backoff re-probes
+  (:class:`FencingPolicy`). One dead replica = degraded capacity, never
+  a failed query (:class:`~raft_tpu.serve.errors.ReplicaUnavailableError`
+  only when EVERY twin is out).
 - :class:`ShardedMutableIndex` — the same lifecycle scatter-gathered
   across a mesh: S device-pinned shards with hash-routed writes
   (:func:`shard_of`), one ``select_k`` merge over every shard's
   sealed+delta candidates, and STAGGERED per-shard compaction (one shard
   folded per Compactor cycle — no global stop-the-world). Serve, canary
-  and request tracing resolve it duck-typed.
+  and request tracing resolve it duck-typed; ``replicas=R`` makes every
+  shard a :class:`ReplicatedShard` with device anti-affinity.
 
-Worked example + consistency model: docs/streaming.md. Metrics
-(``raft_tpu_stream_*``): docs/observability.md. The serve write path
-(`SearchService.upsert/delete`) routes here: docs/serving.md.
+Worked example + consistency model: docs/streaming.md (durability &
+replication rules under "Durability & replication"). Metrics
+(``raft_tpu_stream_*``, ``raft_tpu_wal_*``, ``raft_tpu_replica_*``):
+docs/observability.md. The serve write path
+(`SearchService.upsert/delete`) routes here: docs/serving.md. Fault
+points for the failover/replay suites: :mod:`raft_tpu.testing.faults`.
 """
 
-from . import compactor, mutable, sharded
+from . import compactor, mutable, replicated, sharded, wal
 from .compactor import CompactionPolicy, Compactor
 from .mutable import (DELTA_MIN_BUCKET, DeltaFullError, MutableIndex,
                       delta_buckets, load, save)
+from .replicated import FencingPolicy, ReplicatedShard
 from .sharded import ShardedMutableIndex, shard_of
+from .wal import WalCorruptError, WriteAheadLog
 
 __all__ = [
-    "mutable", "compactor", "sharded",
+    "mutable", "compactor", "sharded", "replicated", "wal",
     "MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET", "delta_buckets",
     "ShardedMutableIndex", "shard_of",
+    "ReplicatedShard", "FencingPolicy",
+    "WriteAheadLog", "WalCorruptError",
     "Compactor", "CompactionPolicy",
     "save", "load",
 ]

@@ -55,6 +55,7 @@ from ..distance.types import DistanceType, resolve_metric
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..serve.errors import OverloadedError
+from ..testing import faults
 
 __all__ = ["MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET",
            "delta_buckets", "save", "load"]
@@ -99,6 +100,21 @@ def _bucket_for(n: int, capacity: int) -> int:
     while b < n:
         b *= 2
     return min(b, capacity)
+
+
+def check_upsert_ids(ids, r: int) -> np.ndarray:
+    """Validate caller-chosen upsert ids — the ONE id contract shared by
+    the plain, sharded and replicated write paths (shape ``(r,)``,
+    unique within the call, ``>= 0``, int32-representable for the device
+    id maps). Returns the int64 array."""
+    gids = np.asarray(ids, np.int64).reshape(-1)
+    expects(gids.shape == (r,), "ids must match rows (%d)", r)
+    expects(np.unique(gids).size == r,
+            "upsert ids must be unique within one call")
+    expects(int(gids.min()) >= 0, "ids must be >= 0")
+    expects(int(gids.max()) < 2 ** 31 - 1,
+            "ids must fit int32 (device id maps are int32)")
+    return gids
 
 
 # -- metrics (catalogue: docs/observability.md) ------------------------------
@@ -469,7 +485,8 @@ class MutableIndex:
                  delta_capacity: int = 1024, retain_vectors: bool | None = None,
                  dataset=None, builder: Callable | None = None,
                  ids=None, device=None, name: str = "default",
-                 shard: int | None = None,
+                 shard: int | None = None, wal=None,
+                 snapshot_path: str | None = None,
                  clock: Callable[[], float] = time.monotonic):
         kind, module = _resolve_kind(sealed)
         n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
@@ -514,6 +531,25 @@ class MutableIndex:
         self._clock = clock
         self._lock = threading.RLock()
         self._compact_lock = threading.Lock()
+        # durability (docs/streaming.md "Durability & replication"): a WAL
+        # logs every upsert/delete at admission, BEFORE the memtable sees
+        # it; snapshot_path couples compaction swaps to an atomic snapshot
+        # + WAL truncation. A fresh wrap refuses a log that already holds
+        # records — those belong to an earlier life of this index and must
+        # be recovered through stream.load(wal=), not silently shadowed.
+        if wal is not None and not hasattr(wal, "append_upsert"):
+            from .wal import WriteAheadLog
+
+            wal = WriteAheadLog(wal, name=name)
+        self._wal = wal
+        self._wal_seq = 0
+        self._snapshot_path = snapshot_path
+        if wal is not None:
+            expects(wal.seq == 0,
+                    "WAL %r already holds records (seq=%d) — a fresh wrap "
+                    "would shadow them; recover with stream.load(wal=) or "
+                    "point at a fresh log", getattr(wal, "path", "?"),
+                    wal.seq)
         if ids is None:
             id_map = np.arange(n, dtype=np.int64)
         else:
@@ -663,6 +699,18 @@ class MutableIndex:
         else:
             obs_mem.reaccount(st.mem, device=dev, host=host)
 
+    def _growth_bytes(self, r: int) -> int:
+        """Device bytes a write of ``r`` rows would newly allocate — the
+        uniform admission surface the sharded/replicated tiers price their
+        hoisted whole-or-nothing gate with."""
+        return self._delta_growth_bytes(self._state, r)
+
+    def _delta_rows_now(self) -> int:
+        """Current delta occupancy for hoisted admission checks (reads a
+        snapshot without the lock: concurrent folds only SHRINK a delta,
+        so a stale read can only over-refuse, never admit past capacity)."""
+        return int(self._state.delta_n)
+
     def _delta_growth_bytes(self, st: _StreamState, r: int) -> int:
         """Device bytes a write of ``r`` rows would newly allocate: the
         delta bucket ladder only grows in power-of-two steps, and a grown
@@ -715,14 +763,16 @@ class MutableIndex:
                 gids = np.arange(self._next_id, self._next_id + r,
                                  dtype=np.int64)
             else:
-                gids = np.asarray(ids, np.int64).reshape(-1)
-                expects(gids.shape == (r,), "ids must match rows (%d)", r)
-                expects(np.unique(gids).size == r,
-                        "upsert ids must be unique within one call")
-                expects(int(gids.min()) >= 0, "ids must be >= 0")
+                gids = check_upsert_ids(ids, r)
             expects(int(gids.max()) < 2 ** 31 - 1,
                     "ids must fit int32 (device id maps are int32)")
             self._next_id = max(self._next_id, int(gids.max()) + 1)
+            if self._wal is not None:
+                # write-ahead: the record is durable BEFORE the memtable
+                # mutates; a crash in the window below replays it at load
+                self._wal_seq = self._wal.append_upsert(rows, gids)
+                faults.fire("stream/post-wal", name=self._cfg.name,
+                            op="upsert")
             sealed_dirty = self._tombstone_locked(st, gids.tolist())
             p = st.delta_n
             st.delta[p:p + r] = rows
@@ -770,6 +820,10 @@ class MutableIndex:
         arr = np.asarray(ids).reshape(-1)
         with self._lock:
             st = self._state
+            if self._wal is not None and arr.size:
+                self._wal_seq = self._wal.append_delete(arr)
+                faults.fire("stream/post-wal", name=self._cfg.name,
+                            op="delete")
             before = len(self._loc)
             sealed_dirty = self._tombstone_locked(st, arr.tolist())
             n = before - len(self._loc)
@@ -1076,32 +1130,53 @@ class MutableIndex:
                         epoch=nd.epoch)
                     obs_mem.retire(old_sealed_mem)
                 self._update_gauges(nd)
-            return {"mode": mode, "epoch": nd.epoch,
-                    "folded": int(len(d_src)), "reclaimed": int(reclaimed),
-                    "sealed_rows": int(len(nd.id_map)),
-                    "delta_remaining": int(rem),
-                    "wall_s": round(time.perf_counter() - t0, 3)}
+            report = {"mode": mode, "epoch": nd.epoch,
+                      "folded": int(len(d_src)), "reclaimed": int(reclaimed),
+                      "sealed_rows": int(len(nd.id_map)),
+                      "delta_remaining": int(rem),
+                      "wall_s": round(time.perf_counter() - t0, 3)}
+            if self._wal is not None and self._snapshot_path is not None:
+                # WAL truncation rides the compaction swap: the post-fold
+                # state lands atomically at snapshot_path (save() also
+                # resets the log once the rename is durable), so the WAL
+                # never outgrows one epoch's writes
+                save(self, self._snapshot_path)
+                report["snapshot"] = self._snapshot_path
+            return report
 
 
 # -- serialization (raft_tpu/8 "stream" section) -----------------------------
 
 def save(mutable: MutableIndex, path: str) -> None:
     """Serialize the FULL mutable state — sealed index, delta memtable,
-    tombstone bitsets, id map — as one ``stream`` section (raft_tpu/8).
-    The sealed index rides embedded through its own module serializer
-    (``write_index``), so its layout/back-compat rules are unchanged."""
-    from ..core.serialize import (serialize_header, serialize_mdspan,
-                                  serialize_scalar)
+    tombstone bitsets, id map — as one ``stream`` section (raft_tpu/10;
+    /8 layout plus the WAL coordination seq). The sealed index rides
+    embedded through its own module serializer (``write_index``), so its
+    layout/back-compat rules are unchanged.
+
+    ATOMIC: the bytes land in a same-directory temp file and replace
+    ``path`` in one ``os.replace`` — a crash mid-save leaves the previous
+    snapshot readable (:func:`raft_tpu.core.serialize.atomic_write`; the
+    fault-injection suite pins it). When the index carries a WAL, the log
+    is truncated AFTER the rename is durable: crash before the rename
+    keeps old snapshot + full log, crash between rename and truncate keeps
+    the new snapshot + a log whose records are all ≤ its ``wal_seq`` (and
+    replay skips them) — no ordering loses an acknowledged write."""
+    from ..core import serialize
+    from ..core.serialize import (atomic_write, serialize_header,
+                                  serialize_mdspan, serialize_scalar)
 
     with mutable._lock:
         st = mutable._state
         cfg = mutable._cfg
-        with open(path, "wb") as f:
+        with atomic_write(path) as f:
             serialize_header(f, "stream")
             serialize_scalar(f, cfg.kind)
             serialize_scalar(f, cfg.name)
             serialize_scalar(f, mutable.delta_capacity)
             serialize_scalar(f, int(mutable._next_id))
+            if serialize.version_number(serialize.SERIALIZATION_VERSION) >= 10:
+                serialize_scalar(f, int(mutable._wal_seq))
             serialize_scalar(f, int(st.delta_n))
             serialize_scalar(f, st.store is not None)
             serialize_mdspan(f, st.id_map)
@@ -1112,27 +1187,43 @@ def save(mutable: MutableIndex, path: str) -> None:
             if st.store is not None:
                 serialize_mdspan(f, st.store)
             cfg.module.write_index(f, st.sealed)
+        if mutable._wal is not None:
+            mutable._wal.reset()
 
 
 def load(path: str, *, search_params=None, index_params=None,
          builder: Callable | None = None, name: str | None = None,
-         device=None,
+         device=None, wal=None, snapshot_path: str | None = None,
          clock: Callable[[], float] = time.monotonic) -> MutableIndex:
     """Load a :func:`save`d mutable index. ``search_params``/
     ``index_params``/``builder``/``device`` are runtime configuration (like
-    every other index loader) and are supplied fresh here."""
+    every other index loader) and are supplied fresh here.
+
+    ``wal`` (a path or :class:`~raft_tpu.stream.wal.WriteAheadLog`) is the
+    crash-recovery entry: every intact record with ``seq`` past the
+    snapshot's ``wal_seq`` replays through the ordinary write path (WAL
+    appends suppressed — the records are already in the log), then the log
+    re-attaches for new writes. ``m.last_recovery`` reports
+    ``{replayed, skipped, torn}``; follow with ``warm()`` + a registry
+    publish for the zero-cold-compile cold-start path (docs/streaming.md
+    "Durability & replication"). ``snapshot_path`` re-arms the
+    compaction-coupled snapshot+truncation (defaults to ``path`` whenever
+    a WAL is given — recovering WITHOUT re-arming snapshots would let the
+    log grow past what the next crash can afford to replay)."""
     from ..core.serialize import (check_header, deserialize_mdspan,
-                                  deserialize_scalar)
+                                  deserialize_scalar, version_number)
     from ..neighbors import brute_force, cagra, ivf_flat, ivf_pq
 
     mods = {"brute_force": brute_force, "ivf_flat": ivf_flat,
             "ivf_pq": ivf_pq, "cagra": cagra}
     with open(path, "rb") as f:
-        check_header(f, "stream")
+        ver = check_header(f, "stream")
         kind = deserialize_scalar(f)
         saved_name = deserialize_scalar(f)
         capacity = int(deserialize_scalar(f))
         next_id = int(deserialize_scalar(f))
+        wal_seq = (int(deserialize_scalar(f))
+                   if version_number(ver) >= 10 else 0)
         delta_n = int(deserialize_scalar(f))
         has_store = bool(deserialize_scalar(f))
         id_map = np.asarray(deserialize_mdspan(f))
@@ -1143,10 +1234,12 @@ def load(path: str, *, search_params=None, index_params=None,
         store = np.asarray(deserialize_mdspan(f)) if has_store else None
         sealed = mods[kind].read_index(f)
 
+    if snapshot_path is None and wal is not None:
+        snapshot_path = path
     m = MutableIndex(sealed, search_params=search_params,
                      index_params=index_params, delta_capacity=capacity,
                      retain_vectors=has_store, dataset=store, builder=builder,
-                     device=device,
+                     device=device, snapshot_path=snapshot_path,
                      name=saved_name if name is None else name, clock=clock)
     with m._lock:
         st = m._state
@@ -1168,4 +1261,27 @@ def load(path: str, *, search_params=None, index_params=None,
         _refresh_delta(st, capacity)
         m._loc = _build_loc(st)
         m._update_gauges(st)
+        m._wal_seq = wal_seq
+    if wal is not None:
+        if not hasattr(wal, "replay"):
+            from .wal import WriteAheadLog
+
+            wal = WriteAheadLog(wal, name=m.name)
+        # replay through the ORDINARY write path (m._wal is still None, so
+        # nothing re-appends): every acknowledged write past the snapshot
+        # comes back with read-your-writes semantics intact
+        replayed, last = 0, wal_seq
+        for seq, op, rows, ids in wal.replay(after_seq=wal_seq):
+            if op == "upsert":
+                m.upsert(rows, ids=ids)
+            else:
+                m.delete(ids)
+            replayed, last = replayed + 1, seq
+        m.last_recovery = {
+            "replayed": replayed,
+            "skipped": wal.last_scan["records"] - replayed,
+            "torn": wal.last_scan["torn"], "wal_seq": last}
+        with m._lock:
+            m._wal = wal
+            m._wal_seq = last
     return m

@@ -1,0 +1,707 @@
+"""ReplicatedShard: R device-pinned MutableIndex twins behind one surface.
+
+PR 9's sharded tier spread the corpus across devices, but each shard stayed
+a single point of failure: one wedged or crashed device failed every query
+routed to it. This module is the availability half of ROADMAP item 3 —
+replica groups with read failover — built from pieces that already exist:
+the twins are ordinary :class:`~raft_tpu.stream.MutableIndex` objects
+(device-pinned via ``device=``, per-replica mem-ledger attribution under
+``name/r<j>``), writes reuse the hoisted whole-or-nothing admission pattern
+of the sharded upsert, and the scatter-gather composes a group exactly
+where it composed a single shard. Semantics:
+
+- **Writes apply to all live replicas.** Deterministic refusals
+  (:class:`~raft_tpu.stream.DeltaFullError`,
+  :class:`~raft_tpu.serve.errors.MemoryBudgetError`) are hoisted BEFORE
+  any replica writes — nothing lands anywhere, the same whole-or-nothing
+  contract as a cross-shard upsert. A replica whose write RAISES past
+  admission (device fault) is marked **stale** and fenced from reads — it
+  missed an acknowledged write, and serving from it would un-acknowledge
+  it; the write succeeds as long as one twin (plus the WAL, when armed)
+  holds it. Stale is permanent until the replica is rebuilt: a re-probe
+  can heal a slow replica, not a diverged one.
+- **Reads fan to ONE replica**, picked by health + recent latency: fenced
+  and stale replicas are excluded, and among the healthy the lowest
+  scan-wall EWMA wins (the per-replica SLO-burn proxy — a replica burning
+  latency budget stops being picked before it trips the breaker). A
+  failed or deadline-blown scan strikes the replica's circuit breaker
+  (``FencingPolicy.max_consecutive`` consecutive strikes → fenced for
+  ``backoff_s``, doubling per re-fence up to ``backoff_max_s``) and the
+  SAME flush retries the surviving twin — one dead replica means degraded
+  capacity, never a failed query. After the backoff, the next pick
+  half-opens the breaker as a probe: success closes it, failure re-fences
+  with doubled backoff. Only when every replica is fenced/stale/failed
+  does the query raise
+  :class:`~raft_tpu.serve.errors.ReplicaUnavailableError`.
+- **Durability is group-level.** ``wal=`` logs the group's serialized
+  write stream once (the twins are in-memory redundancy; the log is the
+  on-disk copy), ``save()`` snapshots the primary twin atomically with
+  the group's WAL seq and truncates the log, and recovery is
+  ``stream.load(path, wal=)`` — a degraded-to-one restore that recovers
+  every acknowledged write; re-replication is a rebuild (document-level
+  contract: replication protects availability, the WAL protects data).
+
+Fault points (:mod:`raft_tpu.testing.faults`): ``replica/search`` (per
+scan attempt; a callback that advances the injected clock simulates a
+WEDGED replica — the scan "takes" past ``deadline_s`` and strikes the
+breaker with no wall sleep), ``replica/upsert`` (per replica write).
+
+Metrics: ``raft_tpu_replica_*`` (catalogue: docs/observability.md);
+health detail for ``/healthz`` via :meth:`ReplicatedShard.health`.
+Failover semantics: docs/serving.md; write/read rules:
+docs/streaming.md "Durability & replication".
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import functools
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..core.errors import RaftError, expects
+from ..core.resources import default_resources
+from ..obs import mem as obs_mem
+from ..obs import metrics
+from ..serve.errors import ReplicaUnavailableError
+from ..testing import faults
+from . import mutable as _mut
+from .mutable import MutableIndex
+
+__all__ = ["ReplicatedShard", "FencingPolicy"]
+
+
+# -- metrics (catalogue: docs/observability.md) ------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _g_healthy():
+    return metrics.gauge(
+        "raft_tpu_replica_healthy",
+        "replicas currently pickable for reads (not fenced, not stale)")
+
+
+@functools.lru_cache(maxsize=None)
+def _g_stale():
+    return metrics.gauge(
+        "raft_tpu_replica_stale",
+        "replicas that missed an acknowledged write (fenced from reads "
+        "until rebuilt — re-probing cannot heal divergence)")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_fenced():
+    return metrics.counter(
+        "raft_tpu_replica_fenced_total",
+        "replica fencings by reason (error/slow strikes tripping the "
+        "breaker, write = missed write marked stale)")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_failovers():
+    return metrics.counter(
+        "raft_tpu_replica_failovers_total",
+        "reads retried on a surviving twin within the SAME flush after "
+        "the picked replica failed")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_probes():
+    return metrics.counter(
+        "raft_tpu_replica_probes_total",
+        "half-open breaker probes by outcome (ok closes the breaker, "
+        "fail re-fences with doubled backoff)")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_reads():
+    return metrics.counter(
+        "raft_tpu_replica_reads_total",
+        "scans served per replica (the read fan-out's pick distribution)")
+
+
+@dataclasses.dataclass(frozen=True)
+class FencingPolicy:
+    """When a replica stops being trusted (see module doc).
+
+    ``deadline_s`` — a completed scan slower than this counts as a SLOW
+    strike (None disables deadline fencing); the result is still returned
+    (it is valid), but the replica stops being picked once the breaker
+    opens. ``max_consecutive`` — error/slow strikes in a row before the
+    breaker opens. ``backoff_s``/``backoff_max_s`` — fence duration,
+    doubling on each re-fence (the re-probe schedule). ``ewma_alpha`` —
+    smoothing of the per-replica scan-wall EWMA the read pick minimizes.
+    """
+
+    deadline_s: float | None = None
+    max_consecutive: int = 2
+    backoff_s: float = 1.0
+    backoff_max_s: float = 60.0
+    ewma_alpha: float = 0.2
+
+
+class _Health:
+    """One replica's breaker + latency state (mutated under the group
+    lock only)."""
+
+    __slots__ = ("consecutive", "fenced_until", "backoff", "stale", "ewma",
+                 "strikes", "last_error")
+
+    def __init__(self, backoff: float):
+        self.consecutive = 0
+        self.fenced_until = None  # None = breaker closed
+        self.backoff = backoff
+        self.stale = False
+        self.ewma = None
+        self.strikes = 0
+        self.last_error = None
+
+
+class _PinnedGroup:
+    """A serving hook's frozen view of one replica group: each replica's
+    state epoch pinned at hook-creation time (the registry lease-drain
+    contract), with the failover logic live — health/fencing decisions
+    always read the CURRENT breaker state, so a hook issued before a
+    fence still avoids the fenced twin."""
+
+    __slots__ = ("group", "states")
+
+    def __init__(self, group: "ReplicatedShard", states: tuple):
+        self.group = group
+        self.states = states
+
+    def scan_serving(self, queries, k, res=None, k_sealed_clamp=True):
+        def scan(st, q, kk, res=None):
+            ks = (min(int(kk), st.id_map.shape[0]) if k_sealed_clamp
+                  else None)
+            return _mut._scan_state(st, q, kk, res=res, k_sealed=ks)
+
+        return self.group._failover(self.states, queries, k, scan, res=res)
+
+    def search(self, queries, k, res=None):
+        return self.group._failover(
+            self.states, queries, k,
+            lambda st, q, kk, res=None: _mut._search_state(st, q, kk,
+                                                           res=res),
+            res=res)
+
+
+class ReplicatedShard:
+    """R MutableIndex twins behind the MutableIndex surface (see module
+    doc). ``sealed`` is built ONCE and device-put per replica (twins are
+    bit-identical by construction — the crash-recovery bench's parity
+    contract); ``devices`` pins replica ``j`` to ``devices[j]`` (the
+    anti-affinity that makes a replica group survive a device, not just a
+    thread). ``wal``/``snapshot_path`` arm group-level durability;
+    ``policy`` is the :class:`FencingPolicy`. Everything else forwards to
+    each replica's :class:`MutableIndex` (``ids=`` carries global ids for
+    the sharded composition; ``shard=`` the mem-ledger ordinal; replicas
+    attribute under ``name/r<j>``)."""
+
+    def __init__(self, sealed, *, n_replicas: int = 2,
+                 devices: Sequence | None = None, ids=None,
+                 search_params=None, index_params=None,
+                 builder: Callable | None = None,
+                 delta_capacity: int = 1024,
+                 retain_vectors: bool | None = None, dataset=None,
+                 wal=None, snapshot_path: str | None = None,
+                 policy: FencingPolicy = FencingPolicy(),
+                 name: str = "default", shard: int | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        n_replicas = int(n_replicas)
+        expects(n_replicas >= 1, "n_replicas must be >= 1, got %d",
+                n_replicas)
+        if devices is not None:
+            devices = list(devices)
+            expects(len(devices) >= n_replicas,
+                    "%d replicas need %d devices, got %d", n_replicas,
+                    n_replicas, len(devices))
+        self._name = name
+        self._clock = clock
+        self.policy = policy
+        self._lock = threading.RLock()
+        # health/breaker state gets its OWN mutex: the read path's
+        # pick/strike/observe must never wait out a group write's WAL
+        # fsync + R device uploads (held under _lock) — replication is the
+        # availability axis; it must not regress read tail latency
+        self._hlock = threading.Lock()
+        self._rr = 0  # round-robin tie-break cursor
+        kind, _ = _mut._resolve_kind(sealed)
+        self._replicas: list[MutableIndex] = []
+        for j in range(n_replicas):
+            # BruteForce is mutated in place by the wrap (dataset moved to
+            # the pin) — each replica needs its own shell; pytree kinds are
+            # copied by device_put inside MutableIndex anyway
+            sealed_j = copy.copy(sealed) if kind == "brute_force" else sealed
+            self._replicas.append(MutableIndex(
+                sealed_j, search_params=search_params,
+                index_params=index_params, delta_capacity=delta_capacity,
+                retain_vectors=retain_vectors, dataset=dataset,
+                builder=builder, ids=ids,
+                device=devices[j] if devices is not None else None,
+                name=f"{name}/r{j}", shard=shard, clock=clock))
+        self._health = [_Health(policy.backoff_s) for _ in range(n_replicas)]
+        # group-level durability: ONE log for the group's serialized write
+        # stream (the twins are in-memory redundancy; the log is the disk
+        # copy) — see save()/stream.load for the recovery contract
+        if wal is not None and not hasattr(wal, "append_upsert"):
+            from .wal import WriteAheadLog
+
+            wal = WriteAheadLog(wal, name=name)
+        if wal is not None:
+            expects(wal.seq == 0,
+                    "WAL %r already holds records (seq=%d) — recover with "
+                    "stream.load(wal=) before re-replicating",
+                    getattr(wal, "path", "?"), wal.seq)
+        self._wal = wal
+        self._wal_seq = 0
+        self._snapshot_path = snapshot_path
+        self._update_health_gauges()
+
+    # -- introspection (the MutableIndex surface) ---------------------------
+    @property
+    def kind(self) -> str:
+        return self._replicas[0].kind
+
+    @property
+    def dim(self) -> int:
+        return self._replicas[0].dim
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def query_dtype(self) -> str:
+        return self._replicas[0].query_dtype
+
+    @property
+    def delta_capacity(self) -> int:
+        return self._replicas[0].delta_capacity
+
+    @property
+    def can_rebuild(self) -> bool:
+        return all(r.can_rebuild for r in self._replicas)
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def replicas(self) -> tuple:
+        """The per-replica :class:`MutableIndex` twins (read-only tuple —
+        write through the group surface so the twins stay in lockstep)."""
+        return tuple(self._replicas)
+
+    @property
+    def _cfg(self):
+        return self._replicas[0]._cfg
+
+    @property
+    def _buckets(self):
+        return self._replicas[0]._buckets
+
+    def _coerce_rows(self, rows):
+        return self._replicas[0]._coerce_rows(rows)
+
+    @property
+    def size(self) -> int:
+        return self._primary().size
+
+    def _primary(self) -> MutableIndex:
+        """The first non-stale replica — the stats/oracle/snapshot twin
+        (live replicas are in lockstep, so any of them speaks for the
+        group's data)."""
+        for j, h in enumerate(self._health):
+            if not h.stale:
+                return self._replicas[j]
+        return self._replicas[0]
+
+    def _drift_store(self):
+        return self._primary()._drift_store()
+
+    def stats(self) -> dict:
+        """The primary twin's watermarks (lockstep — the Compactor reads
+        them unchanged) plus the group's replica/health detail."""
+        st = self._primary().stats()
+        with self._hlock:
+            now = self._clock()
+            healthy = sum(1 for h in self._health
+                          if not h.stale and (h.fenced_until is None
+                                              or now >= h.fenced_until))
+            st["replicas"] = len(self._replicas)
+            st["healthy"] = healthy
+            st["stale"] = sum(1 for h in self._health if h.stale)
+        return st
+
+    def health(self) -> dict:
+        """Per-replica breaker state for ``/healthz``
+        (``obs.start_http_exporter(replicas=...)``)."""
+        with self._hlock:
+            now = self._clock()
+            reps = []
+            for j, h in enumerate(self._health):
+                fenced = (h.stale or (h.fenced_until is not None
+                                      and now < h.fenced_until))
+                reps.append({
+                    "replica": self._replicas[j].name,
+                    "fenced": bool(fenced), "stale": bool(h.stale),
+                    "consecutive_strikes": h.consecutive,
+                    "strikes_total": h.strikes,
+                    "ewma_ms": (round(h.ewma * 1e3, 3)
+                                if h.ewma is not None else None),
+                    "fenced_until": h.fenced_until,
+                    "last_error": (f"{type(h.last_error).__name__}: "
+                                   f"{str(h.last_error)[:120]}"
+                                   if h.last_error is not None else None),
+                })
+            return {"name": self._name, "replicas": reps,
+                    "healthy": sum(1 for r in reps if not r["fenced"])}
+
+    def _update_health_gauges(self) -> None:
+        if not metrics._enabled:
+            return
+        now = self._clock()
+        healthy = sum(1 for h in self._health
+                      if not h.stale and (h.fenced_until is None
+                                          or now >= h.fenced_until))
+        _g_healthy().set(healthy, name=self._name)
+        _g_stale().set(sum(1 for h in self._health if h.stale),
+                       name=self._name)
+
+    # -- read pick + breaker -------------------------------------------------
+    def _pick(self, exclude: set) -> int | None:
+        """The read replica for one attempt: a probe-due fenced replica
+        (fence expired, earliest first) half-opens FIRST — without a
+        background prober, the next pick is the only chance a fenced twin
+        gets to heal, and a failed probe re-fences with same-call failover
+        covering the query; otherwise the healthy (breaker-closed) replica
+        with the lowest scan-wall EWMA, round-robin among ties; None when
+        nothing is pickable."""
+        with self._hlock:
+            now = self._clock()
+            closed, probes = [], []
+            for j, h in enumerate(self._health):
+                if j in exclude or h.stale:
+                    continue
+                if h.fenced_until is None:
+                    closed.append(j)
+                elif now >= h.fenced_until:
+                    probes.append((h.fenced_until, j))
+            if probes:
+                return min(probes)[1]
+            if closed:
+                self._rr += 1
+                rr = self._rr
+                return min(closed,
+                           key=lambda j: (self._health[j].ewma or 0.0,
+                                          (j - rr) % len(self._health)))
+            return None
+
+    def _strike(self, j: int, reason: str, exc=None) -> None:
+        with self._hlock:
+            h = self._health[j]
+            h.consecutive += 1
+            h.strikes += 1
+            if exc is not None:
+                h.last_error = exc
+            was_probe = h.fenced_until is not None
+            if was_probe or h.consecutive >= self.policy.max_consecutive:
+                h.fenced_until = self._clock() + h.backoff
+                h.backoff = min(h.backoff * 2, self.policy.backoff_max_s)
+                if metrics._enabled:
+                    _c_fenced().inc(1, name=self._name, reason=reason)
+                    if was_probe:
+                        _c_probes().inc(1, name=self._name, outcome="fail")
+            self._update_health_gauges()
+
+    def _observe_ok(self, j: int, wall: float) -> bool:
+        """Record a completed scan; returns True if it counted as a SLOW
+        strike (the caller still returns the valid result)."""
+        p = self.policy
+        slow = p.deadline_s is not None and wall > p.deadline_s
+        with self._hlock:
+            h = self._health[j]
+            h.ewma = (wall if h.ewma is None
+                      else (1 - p.ewma_alpha) * h.ewma + p.ewma_alpha * wall)
+            if slow:
+                pass  # strike accounting below, outside the success path
+            else:
+                if h.fenced_until is not None and metrics._enabled:
+                    _c_probes().inc(1, name=self._name, outcome="ok")
+                h.consecutive = 0
+                h.fenced_until = None  # a successful probe closes the breaker
+                h.backoff = self.policy.backoff_s
+            self._update_health_gauges()
+        if slow:
+            self._strike(j, "slow")
+        return slow
+
+    def _failover(self, states, queries, k, scan, res=None):
+        """Run ``scan`` on one replica, failing over to the surviving
+        twins IN THE SAME CALL on error; deadline-slow completions return
+        their (valid) result but strike the breaker for future picks."""
+        from ..obs import requestlog
+
+        tried: set = set()
+        last_exc = None
+        while True:
+            j = self._pick(tried)
+            if j is None:
+                with self._hlock:
+                    fenced = sum(
+                        1 for h in self._health
+                        if h.stale or h.fenced_until is not None)
+                raise ReplicaUnavailableError(
+                    f"replica group {self._name!r}: no replica can serve "
+                    f"({fenced}/{len(self._replicas)} fenced or stale, "
+                    f"{len(tried)} failed this call)",
+                    name=self._name, replicas=len(self._replicas),
+                    fenced=fenced) from last_exc
+            tried.add(j)
+            t0 = self._clock()
+            try:
+                with requestlog.prefix(f"r{j}/"):
+                    faults.fire("replica/search",
+                                replica=self._replicas[j].name, attempt=j)
+                    out = scan(states[j], queries, k, res=res)
+            except ReplicaUnavailableError:
+                raise
+            except faults.FaultError as e:
+                # injected faults simulate device failures — they strike
+                last_exc = e
+                self._strike(j, "error", exc=e)
+                continue
+            except RaftError:
+                # deterministic validation (expects-style: bad query
+                # shape/dim/k) — every twin would refuse identically, so
+                # striking the breaker would let a caller-side bug fence
+                # the whole group and fail subsequent VALID queries
+                raise
+            except Exception as e:
+                last_exc = e
+                self._strike(j, "error", exc=e)
+                continue
+            self._observe_ok(j, self._clock() - t0)
+            if metrics._enabled:
+                if len(tried) > 1:
+                    # counted at SUCCESS, not per failed attempt: the
+                    # metric's contract is "retried on a SURVIVING twin" —
+                    # an all-dead call raises and must not count
+                    _c_failovers().inc(len(tried) - 1, name=self._name)
+                _c_reads().inc(1, name=self._name, replica=f"r{j}")
+            requestlog.annotate("replica", j)
+            return out
+
+    # -- reads ---------------------------------------------------------------
+    def pin_group(self) -> _PinnedGroup:
+        """Freeze every replica's current state epoch behind the live
+        failover logic — what a serving hook (and the sharded scatter)
+        holds across compaction swaps."""
+        return _PinnedGroup(self, tuple(r._state for r in self._replicas))
+
+    def search(self, queries, k: int, res=None):
+        """One replica's full merged search (twins are equivalent), with
+        same-call failover — the :meth:`MutableIndex.search` contract."""
+        return self.pin_group().search(queries, k, res=res)
+
+    def _exact_scan(self, queries, k: int, res=None):
+        """Failover composition of the exact-oracle scan half (the sharded
+        ``exact_search`` calls this per shard)."""
+        return self._failover(
+            tuple(range(len(self._replicas))), queries, k,
+            lambda j, q, kk, res=None: self._replicas[j]._exact_scan(
+                q, kk, res=res),
+            res=res)
+
+    def exact_search(self, queries, k: int, res=None):
+        """EXACT fused kNN over the live corpus via any live twin (the
+        RecallCanary's oracle surface)."""
+        sd, si, dd, di = self._exact_scan(queries, k, res=res)
+        return _mut._merge(sd, si, dd, di, int(k),
+                           self._cfg.select_min)
+
+    def searcher(self):
+        """Serving hook pinned to the group's current epochs (the
+        ``batched_searcher`` contract), failover inside."""
+        from ..neighbors._hooks import make_hook
+
+        pin = self.pin_group()
+        cfg = self._cfg
+        fn = make_hook(lambda queries, k: pin.search(queries, k),
+                       f"stream/replicated/{cfg.kind}", cfg.dim,
+                       cfg.data_kind)
+        fn.mutable = self
+        return fn
+
+    # -- writes --------------------------------------------------------------
+    def _delta_rows_now(self) -> int:
+        return max(r._delta_rows_now() for r in self._live())
+
+    def _growth_bytes(self, r: int) -> int:
+        return sum(rep._growth_bytes(r) for rep in self._live())
+
+    def _live(self) -> list[MutableIndex]:
+        return [rep for rep, h in zip(self._replicas, self._health)
+                if not h.stale] or [self._replicas[0]]
+
+    def upsert(self, rows, ids=None, res=None):
+        """Insert/upsert on every live replica. Deterministic admission
+        (capacity, memory budget) is hoisted across the group BEFORE the
+        WAL append and before any replica writes — whole-or-nothing; a
+        replica that fails PAST admission is marked stale and fenced (it
+        missed an acknowledged write), and the write succeeds as long as
+        one twin applied it."""
+        rows = self._coerce_rows(rows)
+        r = rows.shape[0]
+        expects(r >= 1, "upsert needs at least one row")
+        with self._lock:
+            live = [(j, self._replicas[j]) for j in range(len(self._replicas))
+                    if not self._health[j].stale]
+            if not live:
+                raise ReplicaUnavailableError(
+                    f"replica group {self._name!r}: every replica is "
+                    "stale — refusing the write (acknowledging it with "
+                    "no twin to hold it would lose it); rebuild the "
+                    "group", name=self._name,
+                    replicas=len(self._replicas),
+                    fenced=len(self._replicas))
+            gids = self._assign_ids(r, ids)
+            # hoisted admission: every live twin must have room (lockstep
+            # makes these equal, but a refusal after a sibling accepted
+            # would break whole-or-nothing, so check them all)
+            for j, rep in live:
+                if rep._delta_rows_now() + r > rep.delta_capacity:
+                    if metrics._enabled:
+                        _mut._c_delta_full().inc(1, name=self._name)
+                    raise _mut.DeltaFullError(
+                        f"replica {rep.name} delta at "
+                        f"{rep._delta_rows_now()}/{rep.delta_capacity} "
+                        f"rows; upsert of {r} refused — compact() to fold")
+            obs_mem.gate(res or default_resources(),
+                         lambda: self._growth_bytes(r),
+                         site="upsert",
+                         detail=f"stream/replicated {self._name!r}")
+            wal_prev = (self._wal.size_bytes
+                        if self._wal is not None else None)
+            if self._wal is not None:
+                self._wal_seq = self._wal.append_upsert(rows, gids)
+                faults.fire("stream/post-wal", name=self._name, op="upsert")
+            inner = res or default_resources()
+            if getattr(inner, "memory_budget_bytes", None) is not None:
+                inner = dataclasses.replace(inner, memory_budget_bytes=None)
+            self._apply(live, "upsert",
+                        lambda rep: rep.upsert(rows, ids=gids, res=inner),
+                        wal_prev=wal_prev)
+        return gids
+
+    def delete(self, ids) -> int:
+        """Tombstone ids on every live replica; returns how many were
+        live (the primary twin's count — lockstep)."""
+        arr = np.asarray(ids, np.int64).reshape(-1)
+        if arr.size == 0:
+            return 0
+        with self._lock:
+            live = [(j, self._replicas[j]) for j in range(len(self._replicas))
+                    if not self._health[j].stale]
+            if not live:
+                raise ReplicaUnavailableError(
+                    f"replica group {self._name!r}: every replica is "
+                    "stale — refusing the write (acknowledging it with "
+                    "no twin to hold it would lose it); rebuild the "
+                    "group", name=self._name,
+                    replicas=len(self._replicas),
+                    fenced=len(self._replicas))
+            wal_prev = (self._wal.size_bytes
+                        if self._wal is not None else None)
+            if self._wal is not None:
+                self._wal_seq = self._wal.append_delete(arr)
+                faults.fire("stream/post-wal", name=self._name, op="delete")
+            box: dict = {}
+
+            def do(rep, _box=box):
+                n = rep.delete(arr)
+                _box.setdefault("n", n)
+
+            self._apply(live, "delete", do, wal_prev=wal_prev)
+        return int(box.get("n", 0))
+
+    def _assign_ids(self, r: int, ids):
+        if ids is None:
+            base = max(rep._next_id for rep in self._replicas)
+            return np.arange(base, base + r, dtype=np.int64)
+        return _mut.check_upsert_ids(ids, r)
+
+    def _apply(self, live, op: str, fn, wal_prev=None) -> None:
+        """Forward one admitted write to every live twin; a raising twin
+        goes STALE (fenced from reads — it missed the write). If EVERY
+        twin failed, the write itself failed: its WAL record (appended
+        write-ahead under the same lock) is rolled back so recovery
+        cannot resurrect a write the caller was told did not land, and
+        the last error re-raises."""
+        ok = 0
+        last = None
+        for j, rep in live:
+            try:
+                faults.fire(f"replica/{op}", replica=rep.name)
+                fn(rep)
+                ok += 1
+            except Exception as e:
+                last = e
+                with self._hlock:
+                    h = self._health[j]
+                    h.stale = True
+                    h.last_error = e
+                if metrics._enabled:
+                    _c_fenced().inc(1, name=self._name, reason="write")
+        with self._hlock:
+            self._update_health_gauges()
+        if ok == 0 and last is not None:
+            if self._wal is not None and wal_prev is not None:
+                self._wal.rollback_last(self._wal_seq, wal_prev)
+                self._wal_seq -= 1
+            raise last
+
+    # -- compaction / warm / durability --------------------------------------
+    def compact(self, mode: str = "auto", res=None,
+                trigger: str | None = None) -> dict:
+        """Fold every live twin (each through its ordinary off-lock
+        fold+swap — readers keep serving whichever twin is not mid-swap,
+        and the swap itself is atomic per twin). Report = the primary
+        fold's report + per-replica walls; with group durability armed,
+        the post-fold snapshot + WAL truncation ride here exactly like the
+        single-index path."""
+        reports = []
+        for rep in self._live():
+            reports.append(rep.compact(mode=mode, res=res))
+        report = dict(reports[0])
+        report["replica_wall_s"] = [rp["wall_s"] for rp in reports]
+        if self._wal is not None and self._snapshot_path is not None:
+            self.save(self._snapshot_path)
+            report["snapshot"] = self._snapshot_path
+        return report
+
+    def warm(self, buckets, ks=(10,), sample=None) -> dict:
+        """Warm EVERY replica's delta-ladder program set (failover must
+        never cold-compile — a twin that was never picked still has to be
+        hot the moment its sibling is fenced)."""
+        return {f"r{j}": rep.warm(buckets, ks=ks, sample=sample)
+                for j, rep in enumerate(self._replicas)}
+
+    def save(self, path: str) -> None:
+        """Atomic group snapshot: the primary twin's full state stamped
+        with the GROUP's WAL seq, then the group log truncates (same
+        crash-ordering argument as :func:`raft_tpu.stream.mutable.save`).
+        Recovery: ``stream.load(path, wal=...)`` — a degraded-to-one
+        restore of every acknowledged write; re-replicate by rebuilding
+        the group around the recovered corpus."""
+        with self._lock:
+            primary = self._primary()
+            with primary._lock:
+                primary._wal_seq = self._wal_seq
+                _mut.save(primary, path)
+            if self._wal is not None:
+                self._wal.reset()

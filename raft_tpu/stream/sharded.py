@@ -70,6 +70,7 @@ from ..obs import mem as obs_mem
 from ..obs import metrics
 from . import mutable as _mut
 from .mutable import DeltaFullError, MutableIndex
+from .replicated import FencingPolicy, ReplicatedShard, _PinnedGroup
 
 __all__ = ["ShardedMutableIndex", "shard_of"]
 
@@ -116,6 +117,15 @@ def _serving_scan(st, queries, k, res=None):
     rows (small shards contribute what they have; the merge pads)."""
     return _mut._scan_state(st, queries, k, res=res,
                             k_sealed=min(int(k), st.id_map.shape[0]))
+
+
+def _view_scan(view, queries, k, res=None):
+    """Per-shard scan over a pinned view: a plain shard's state runs the
+    single-replica scan; a replica group's pinned view routes through its
+    health-picked twin with same-flush failover."""
+    if isinstance(view, _PinnedGroup):
+        return view.scan_serving(queries, k, res=res)
+    return _serving_scan(view, queries, k, res=res)
 
 
 def _merge_parts(ds, is_, k: int, select_min: bool):
@@ -175,6 +185,8 @@ class ShardedMutableIndex:
                  delta_capacity: int = 1024,
                  retain_vectors: bool | None = None,
                  devices: Sequence | None = None, comms=None,
+                 replicas: int = 1,
+                 fencing: FencingPolicy | None = None,
                  name: str = "default",
                  clock: Callable[[], float] = time.monotonic):
         dataset = np.asarray(dataset)
@@ -200,7 +212,18 @@ class ShardedMutableIndex:
         self._clock = clock  # Compactor inherits it (one age time base)
         self._lock = threading.RLock()
         self._compact_lock = threading.Lock()
-        self._shards: list[MutableIndex] = []
+        R = int(replicas)
+        expects(R >= 1, "replicas must be >= 1, got %d", R)
+        if R > 1 and devices is not None:
+            # twins of one shard land on devices[(s*R + j) % D]: j1 and j2
+            # collide iff D divides j1-j2, i.e. iff D < R — and co-located
+            # twins silently void the device anti-affinity the replica
+            # groups promise (pass devices=None for unpinned twins)
+            expects(len(devices) >= R,
+                    "replica anti-affinity needs >= %d devices so twins "
+                    "of one shard land on different devices, got %d",
+                    R, len(devices))
+        self._shards: list = []
         for s in range(n_shards):
             rows_idx = np.nonzero(owner == s)[0]
             expects(len(rows_idx) > 0,
@@ -208,17 +231,36 @@ class ShardedMutableIndex:
                     s, n_shards, n)
             rows_s = dataset[rows_idx]
             sealed = build(rows_s)
-            self._shards.append(MutableIndex(
-                sealed, search_params=search_params,
-                index_params=index_params,
-                delta_capacity=delta_capacity,
-                # the constructor holds the shard's raw rows either way, so
-                # retention costs no extra recover pass; False opts out
-                retain_vectors=retain_vectors,
-                dataset=None if retain_vectors is False else rows_s,
-                builder=builder, ids=gids[rows_idx],
-                device=devices[s] if devices is not None else None,
-                name=f"{name}/shard{s}", shard=s, clock=clock))
+            if R == 1:
+                self._shards.append(MutableIndex(
+                    sealed, search_params=search_params,
+                    index_params=index_params,
+                    delta_capacity=delta_capacity,
+                    # the constructor holds the shard's raw rows either
+                    # way, so retention costs no extra recover pass; False
+                    # opts out
+                    retain_vectors=retain_vectors,
+                    dataset=None if retain_vectors is False else rows_s,
+                    builder=builder, ids=gids[rows_idx],
+                    device=devices[s] if devices is not None else None,
+                    name=f"{name}/shard{s}", shard=s, clock=clock))
+            else:
+                # replica j of shard s lands on devices[s*R + j] (mod the
+                # mesh): twins of one shard live on DIFFERENT devices —
+                # the anti-affinity that makes a group survive a device
+                self._shards.append(ReplicatedShard(
+                    sealed, n_replicas=R,
+                    devices=([devices[(s * R + j) % len(devices)]
+                              for j in range(R)]
+                             if devices is not None else None),
+                    search_params=search_params,
+                    index_params=index_params,
+                    delta_capacity=delta_capacity,
+                    retain_vectors=retain_vectors,
+                    dataset=None if retain_vectors is False else rows_s,
+                    builder=builder, ids=gids[rows_idx],
+                    policy=fencing or FencingPolicy(),
+                    name=f"{name}/shard{s}", shard=s, clock=clock))
         cfg0 = self._shards[0]._cfg
         for s, sh in enumerate(self._shards[1:], 1):
             expects(sh._cfg.kind == cfg0.kind and sh._cfg.dim == cfg0.dim
@@ -288,7 +330,25 @@ class ShardedMutableIndex:
             "epoch": sum(p["epoch"] for p in per),
             "shards": len(per),
             "per_shard": per,
+            # replica-group detail (replicas=1: every shard is its own
+            # single healthy "replica"): healthy is the WORST shard's
+            # pickable-twin count — the availability binding constraint
+            **({"replicas": sum(p.get("replicas", 1) for p in per),
+                "healthy": min(p.get("healthy", 1) for p in per),
+                "stale": sum(p.get("stale", 0) for p in per)}
+               if any("replicas" in p for p in per) else {}),
         }
+
+    def health(self) -> dict:
+        """Per-shard replica-group health for ``/healthz``
+        (``obs.start_http_exporter(replicas=...)``): each group's breaker
+        detail plus the mesh verdict — a shard with ZERO pickable twins
+        means queries to it fail, which is an outage, not degradation."""
+        shards = [sh.health() if isinstance(sh, ReplicatedShard)
+                  else {"name": sh.name, "replicas": [], "healthy": 1}
+                  for sh in self._shards]
+        return {"name": self._name, "shards": shards,
+                "healthy_min": min(s["healthy"] for s in shards)}
 
     def _update_gauges(self, st: dict | None = None) -> None:
         if not metrics._enabled:
@@ -306,7 +366,7 @@ class ShardedMutableIndex:
         """Cross-shard corpus sample for the drift detector: an interleave
         of every shard's retained rows (bounded — the classifier subsamples
         downstream anyway); None when any shard dropped its store."""
-        stores = [sh._state.store for sh in self._shards]
+        stores = [sh._drift_store() for sh in self._shards]
         if any(s is None for s in stores):
             return None
         cap = max(4096 // len(stores), 256)
@@ -335,13 +395,7 @@ class ShardedMutableIndex:
                 gids = np.arange(self._next_id, self._next_id + r,
                                  dtype=np.int64)
             else:
-                gids = np.asarray(ids, np.int64).reshape(-1)
-                expects(gids.shape == (r,), "ids must match rows (%d)", r)
-                expects(np.unique(gids).size == r,
-                        "upsert ids must be unique within one call")
-                expects(int(gids.min()) >= 0, "ids must be >= 0")
-                expects(int(gids.max()) < 2 ** 31 - 1,
-                        "ids must fit int32 (device id maps are int32)")
+                gids = _mut.check_upsert_ids(ids, r)
             self._next_id = max(self._next_id, int(gids.max()) + 1)
             owner = shard_of(gids, len(self._shards))
             groups = [np.nonzero(owner == s)[0]
@@ -350,23 +404,23 @@ class ShardedMutableIndex:
                 sh = self._shards[s]
                 # concurrent folds only SHRINK a delta, so a stale read
                 # here can only over-refuse, never admit past capacity
-                if len(idx) and (sh._state.delta_n + len(idx)
+                if len(idx) and (sh._delta_rows_now() + len(idx)
                                  > sh.delta_capacity):
                     if metrics._enabled:
                         _mut._c_delta_full().inc(1, name=self._name)
                     raise DeltaFullError(
-                        f"shard {s} delta at {sh._state.delta_n}"
+                        f"shard {s} delta at {sh._delta_rows_now()}"
                         f"/{sh.delta_capacity} rows; upsert routing "
                         f"{len(idx)} there refused — compact() (or attach "
                         "a stream.Compactor) to fold it")
             # memory-budget admission, hoisted like the capacity check: the
-            # SUMMED bucket growth across home shards gates before any
-            # shard writes (cross-shard whole-or-nothing)
+            # SUMMED bucket growth across home shards (and, for replica
+            # groups, across every live twin) gates before any shard
+            # writes (cross-shard whole-or-nothing)
             obs_mem.gate(
                 res or default_resources(),
                 lambda: sum(
-                    self._shards[s]._delta_growth_bytes(
-                        self._shards[s]._state, len(idx))
+                    self._shards[s]._growth_bytes(len(idx))
                     for s, idx in enumerate(groups) if len(idx)),
                 site="upsert", detail=f"stream/sharded {self._name!r}")
             # the hoisted pass IS the admission decision: the per-shard
@@ -444,10 +498,19 @@ class ShardedMutableIndex:
         fill. Identical result contract to :meth:`MutableIndex.search` —
         the 1-shard composition is bit-equal to a plain MutableIndex
         (pinned by the parity suite). A shard smaller than k contributes
-        every sealed row it has (``k_sealed`` clamp) and the merge pads."""
-        return self._scatter_gather(
-            tuple(sh._state for sh in self._shards), queries, k,
-            _serving_scan, res=res)
+        every sealed row it has (``k_sealed`` clamp) and the merge pads.
+        With ``replicas > 1`` each shard's scan routes through its replica
+        group's health-picked twin, failing over within this same call —
+        one fenced replica degrades capacity, never the query."""
+        return self._scatter_gather(self._views(), queries, k,
+                                    _view_scan, res=res)
+
+    def _views(self) -> tuple:
+        """Per-shard read views: a plain shard pins its current state
+        epoch; a replica group pins EVERY twin's epoch behind the live
+        failover pick (:meth:`ReplicatedShard.pin_group`)."""
+        return tuple(sh.pin_group() if isinstance(sh, ReplicatedShard)
+                     else sh._state for sh in self._shards)
 
     def exact_search(self, queries, k: int, res=None):
         """EXACT fused kNN over the whole mesh's live corpus — shard-local
@@ -470,11 +533,11 @@ class ShardedMutableIndex:
         same lease-drain semantics as the single-device flow, per shard."""
         from ..neighbors._hooks import make_hook
 
-        states = tuple(sh._state for sh in self._shards)
+        states = self._views()
         cfg0 = self._shards[0]._cfg
         fn = make_hook(
             lambda queries, k: self._scatter_gather(
-                states, queries, k, _serving_scan),
+                states, queries, k, _view_scan),
             f"stream/sharded/{cfg0.kind}", cfg0.dim, cfg0.data_kind)
         # marker for the serve write path (SearchService.publish follows it
         # across compaction republishes, exactly like MutableIndex's hook)
@@ -509,28 +572,38 @@ class ShardedMutableIndex:
                 with obs_compile.attribution() as rec:
                     parts_d, parts_i = [], []
                     for sh in self._shards:
-                        cfg = sh._cfg
-                        dt = _mut._np_dtype(cfg.query_dtype)
-                        sd = _mut._dev_put(
-                            cfg, np.zeros((b, kk), np.float32))
-                        si = _mut._dev_put(
-                            cfg, np.full((b, kk), -1, np.int32))
-                        dd = di = None
-                        for db in sh._buckets:
-                            dummy = _mut._dev_put(
-                                cfg, np.zeros((db, cfg.dim), dt))
-                            keep = _mut._dev_put(
-                                cfg, np.zeros((db,), bool))
-                            dd, di = brute_force.knn(
-                                dummy, q, min(kk, db), cfg.metric,
-                                cfg.metric_arg, sample_filter=keep)
-                            di = _mut._map_ids(di, _mut._dev_put(
-                                cfg, np.zeros((db,), np.int32)))
-                            if dd.shape[1] < kk:  # same pad rule as
-                                # _scatter_gather — per (width, device)
-                                dd, di = _pad_part(dd, di, kk,
-                                                   self._select_min)
-                            jax.block_until_ready((dd, di))
+                        # a replica group warms EVERY twin's ladder on its
+                        # own pinned device (placement is part of the
+                        # program key): failover must never cold-compile —
+                        # a twin that was never picked has to be hot the
+                        # moment its sibling is fenced. Any twin's parts
+                        # feed the merge (the gather re-places them).
+                        units = (sh.replicas
+                                 if isinstance(sh, ReplicatedShard)
+                                 else (sh,))
+                        for u in units:
+                            cfg = u._cfg
+                            dt = _mut._np_dtype(cfg.query_dtype)
+                            sd = _mut._dev_put(
+                                cfg, np.zeros((b, kk), np.float32))
+                            si = _mut._dev_put(
+                                cfg, np.full((b, kk), -1, np.int32))
+                            dd = di = None
+                            for db in u._buckets:
+                                dummy = _mut._dev_put(
+                                    cfg, np.zeros((db, cfg.dim), dt))
+                                keep = _mut._dev_put(
+                                    cfg, np.zeros((db,), bool))
+                                dd, di = brute_force.knn(
+                                    dummy, q, min(kk, db), cfg.metric,
+                                    cfg.metric_arg, sample_filter=keep)
+                                di = _mut._map_ids(di, _mut._dev_put(
+                                    cfg, np.zeros((db,), np.int32)))
+                                if dd.shape[1] < kk:  # same pad rule as
+                                    # _scatter_gather — per (width, device)
+                                    dd, di = _pad_part(dd, di, kk,
+                                                       self._select_min)
+                                jax.block_until_ready((dd, di))
                         parts_d += [sd, dd]
                         parts_i += [si, di]
                     if self._merge_device is not None:

@@ -1,0 +1,13 @@
+"""raft_tpu.testing — deterministic test/bench harnesses.
+
+:mod:`raft_tpu.testing.faults` is the fault-injection registry the
+availability layer (replica failover, WAL durability, crash recovery) is
+proven with: named fault points threaded through serve/stream fire injected
+failures deterministically — no wall-clock sleeps, no real process kills —
+so tier-1 can assert every failover and replay path (docs/streaming.md
+"Durability & replication").
+"""
+
+from . import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,179 @@
+"""Deterministic fault injection for the availability layer.
+
+The failure modes the availability axis exists for — a replica that raises
+or wedges mid-search, a WAL write that fails partway through a batch, a
+process that dies between the WAL append and the memtable insert, a crash
+in the middle of a snapshot write — cannot be provoked on demand by real
+hardware, and tests that kill processes or sleep past deadlines are slow
+and flaky. This module is the alternative: **named fault points** compiled
+into the production code paths (one module-flag check when disarmed, the
+same discipline as ``obs.metrics.disable()``), armed explicitly by tests
+and the ``--fault-smoke`` bench rows.
+
+Usage::
+
+    from raft_tpu.testing import faults
+
+    with faults.scope():                      # disarms everything on exit
+        faults.inject("replica/search", exc=RuntimeError("replica died"),
+                      match=lambda ctx: ctx.get("replica", "").endswith("/r0"))
+        ...                                    # r0's scans now raise; its
+        ...                                    # twin serves every query
+        assert faults.fired("replica/search") > 0
+
+Fault points in the tree (grep ``faults.fire`` for the live list):
+
+- ``replica/search`` — fired per replica scan attempt inside
+  :class:`raft_tpu.stream.ReplicatedShard` (ctx: ``replica`` name). An
+  injected ``callback`` can advance the shard's injected clock instead of
+  raising — that is how a WEDGED replica is simulated: the scan "takes"
+  longer than the fencing deadline and trips the slow-strike breaker, with
+  no wall-clock sleep anywhere.
+- ``replica/upsert`` — fired per replica write inside
+  ``ReplicatedShard.upsert`` (ctx: ``replica``); a raise marks the replica
+  STALE (it missed an acknowledged write) and fences it from reads.
+- ``wal/append`` — fired per record before it is written
+  (:meth:`raft_tpu.stream.wal.WriteAheadLog.append`); arm with ``after=k``
+  to fail the k-th record of a batch.
+- ``wal/fsync`` — fired before each batched fsync.
+- ``stream/post-wal`` — fired between the WAL append and the memtable
+  insert in ``MutableIndex.upsert``/``delete`` — the crash window the
+  replay path must cover (arm with :class:`SimulatedCrash`).
+- ``serialize/atomic-write`` — fired between writing the temp file and the
+  ``os.replace`` in :func:`raft_tpu.core.serialize.atomic_write`: a crash
+  here must leave the previous snapshot readable.
+
+Every helper is thread-safe; ``fire`` holds no lock on the disarmed fast
+path. Injected exceptions should derive from :class:`FaultError` (or any
+caller-chosen type — the registry raises whatever it was given).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Callable
+
+from ..core.errors import RaftError, expects
+
+__all__ = ["FaultError", "SimulatedCrash", "inject", "clear", "fire",
+           "fired", "armed", "scope"]
+
+
+class FaultError(RaftError):
+    """Base type for injected failures (so test fences can catch exactly
+    the injected class and nothing else)."""
+
+
+class SimulatedCrash(FaultError):
+    """An injected process death: the code path stops HERE, mid-operation,
+    and recovery is proven by reopening the on-disk state — the in-memory
+    object is considered gone. Derives from :class:`FaultError` (not
+    ``BaseException``) so an un-simulated leak of one still fails tests
+    loudly instead of killing the runner."""
+
+
+class _Fault:
+    __slots__ = ("exc", "callback", "times", "after", "match", "fired",
+                 "skipped")
+
+    def __init__(self, exc, callback, times, after, match):
+        self.exc = exc
+        self.callback = callback
+        self.times = times        # None = every call once armed
+        self.after = int(after)   # skip this many matching calls first
+        self.match = match
+        self.fired = 0
+        self.skipped = 0
+
+
+_lock = threading.Lock()
+_points: dict[str, list[_Fault]] = {}
+_counts: dict[str, int] = {}
+_armed = False  # module fast-path flag: fire() is one read when False
+
+
+def inject(point: str, exc: BaseException | None = None, *,
+           callback: Callable[[dict], None] | None = None,
+           times: int | None = None, after: int = 0,
+           match: Callable[[dict], bool] | None = None) -> None:
+    """Arm fault ``point``. ``exc`` is raised at each triggering call (or
+    ``callback(ctx)`` runs — it may raise itself, or mutate state such as
+    advancing an injected clock to simulate a hang). ``times`` bounds how
+    many calls trigger (None = every one), ``after`` skips the first N
+    matching calls (fail the k-th record of a batch), ``match(ctx)``
+    restricts the fault to matching contexts (one replica of a group).
+    Multiple injections on one point stack in arming order."""
+    global _armed
+    expects(exc is not None or callback is not None,
+            "inject(%r) needs exc= or callback=", point)
+    with _lock:
+        _points.setdefault(point, []).append(
+            _Fault(exc, callback, times, after, match))
+        _armed = True
+
+
+def clear(point: str | None = None) -> None:
+    """Disarm one point (or everything); fired counts reset with it."""
+    global _armed
+    with _lock:
+        if point is None:
+            _points.clear()
+            _counts.clear()
+        else:
+            _points.pop(point, None)
+            _counts.pop(point, None)
+        _armed = bool(_points)
+
+
+def fire(point: str, **ctx) -> None:
+    """Production-side hook: trigger any armed faults at ``point``. A
+    single module-flag read when nothing is armed anywhere — safe on hot
+    paths (the ``obs.metrics._enabled`` discipline)."""
+    if not _armed:
+        return
+    with _lock:
+        flist = _points.get(point)
+        if not flist:
+            return
+        _counts[point] = _counts.get(point, 0) + 1
+        todo = []
+        for f in flist:
+            if f.match is not None and not f.match(ctx):
+                continue
+            if f.skipped < f.after:
+                f.skipped += 1
+                continue
+            if f.times is not None and f.fired >= f.times:
+                continue
+            f.fired += 1
+            todo.append(f)
+    # run actions OUTSIDE the lock: a callback may touch code that fires
+    # other points (or re-enter inject/clear)
+    for f in todo:
+        if f.callback is not None:
+            f.callback(dict(ctx, point=point))
+        if f.exc is not None:
+            raise f.exc
+
+
+def fired(point: str) -> int:
+    """How many times any armed fault at ``point`` actually triggered."""
+    with _lock:
+        return sum(f.fired for f in _points.get(point, ()))
+
+
+def armed(point: str | None = None) -> bool:
+    with _lock:
+        return bool(_points if point is None else _points.get(point))
+
+
+@contextmanager
+def scope():
+    """Context manager for tests: everything injected inside is disarmed
+    on exit, pass or fail — a leaked fault must never poison the next
+    test."""
+    try:
+        yield
+    finally:
+        clear()

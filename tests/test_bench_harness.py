@@ -671,6 +671,83 @@ def test_rows_carry_mem_field(monkeypatch):
         obs.enable()
 
 
+def test_fault_smoke_row():
+    """The --fault-smoke availability row (ISSUE 11 acceptance): a
+    replicated sharded mesh serves a loaded window during which one
+    replica is killed and later revived. The row body asserts the
+    acceptance bits itself (zero failed queries, breaker strikes
+    observed, zero cold compiles after rehearsal); the small-scale twin
+    must come back clean and carry the measured recovery."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_fault_smoke(rows, n=4000, d=16, n_lists=32, k=5, n_probes=8,
+                           steps=60, qbatch=16, fence_at=15, heal_at=40,
+                           delta_capacity=256)
+    row = rows[-1]
+    assert row["name"] == "fault_smoke_100k" and "error" not in row, rows
+    assert row["failed_queries"] == 0, row
+    assert row["strikes"] > 0, row
+    assert row["compile_s_loaded"] == 0.0, row
+    assert row["recovery_s"] > 0, row
+    assert row["qps"] > 0 and row["replicas"] == 2, row
+
+
+def test_crash_recovery_row():
+    """The --fault-smoke crash-durability row (ISSUE 11 acceptance): an
+    injected SimulatedCrash between WAL append and memtable insert, then
+    load() + WAL replay + warm(). The row body asserts id-for-id parity
+    with an uncrashed twin and a compile-free post-warm window; here the
+    small-scale twin must land with recall_recovered == 1.0 (the field
+    bench/compare.py gates like every recall field) and the measured
+    replay economics."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_crash_recovery(rows, n=4000, d=16, n_lists=32, k=5,
+                              n_probes=8, write_steps=10, write_rows=16,
+                              delete_rows=4, delta_capacity=512, n_eval=64)
+    row = rows[-1]
+    assert row["name"] == "crash_recovery_100k" and "error" not in row, rows
+    assert row["recall_recovered"] == 1.0, row
+    assert row["wal_records"] == 2 * 9 + 1, row  # 9 upsert+delete pairs + 1
+    assert row["wal_bytes"] > 0, row
+    assert row["recovery_s"] > 0 and row["replay_rows_per_s"] > 0, row
+    assert row["compile_s_post_warm"] == 0.0, row
+
+
+def test_fault_smoke_flag_runs_only_the_fault_rows(monkeypatch):
+    """`bench.py --fault-smoke` is the availability iteration loop: setup
+    + the two fault rows, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_fault_smoke",
+        lambda rows: rows.append({"name": "fault_smoke_100k",
+                                  "failed_queries": 0}))
+    monkeypatch.setattr(
+        bench, "_row_crash_recovery",
+        lambda rows: rows.append({"name": "crash_recovery_100k",
+                                  "recall_recovered": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--fault-smoke"])
+        assert rc == 0 and calls == ["setup"]
+        names = {r.get("name") for r in bench._STATE["rows"]}
+        assert {"fault_smoke_100k", "crash_recovery_100k"} <= names
+    finally:
+        bench._STATE["rows"].clear()
+
+
 # ---------------------------------------------------------------------------
 # bench/compare.py — the artifact regression gate (ISSUE 10 satellite)
 # ---------------------------------------------------------------------------

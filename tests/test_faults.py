@@ -1,0 +1,789 @@
+"""Availability-axis tests (tier-1 ``faults`` marker, ISSUE 11).
+
+Every failure mode here is provoked deterministically through
+:mod:`raft_tpu.testing.faults` and injected clocks — no process kills, no
+wall-clock sleeps in assertions:
+
+- the fault registry itself (arming, matching, counting, scoped disarm);
+- the write-ahead log (append/replay round trips, torn-tail tolerance,
+  batched fsync accounting, sequence continuity across reopen);
+- the MutableIndex crash windows (crash between WAL append and memtable
+  insert; crash mid-snapshot-save) and the ``load + replay`` recovery
+  path, recall-parity-checked against an uncrashed twin;
+- ReplicatedShard failover (same-call retry, circuit-breaker fencing,
+  backoff re-probes, stale-on-missed-write, whole-or-nothing admission);
+- the sharded mesh with replica groups (one dead replica = zero failed
+  queries) and the ``/healthz`` replica verdict;
+- the client-side bounded retry helper (backoff/jitter policy with an
+  injected clock; never retries a spent deadline).
+"""
+
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import stream
+from raft_tpu.core.errors import RaftError
+from raft_tpu.neighbors import brute_force
+from raft_tpu.serve import (DeadlineExceededError, OverloadedError,
+                            ReplicaUnavailableError, SearchService,
+                            submit_with_retry)
+from raft_tpu.stream import (FencingPolicy, MutableIndex, ReplicatedShard,
+                             ShardedMutableIndex, WriteAheadLog)
+from raft_tpu.stream.wal import WalCorruptError
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.faults
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """A fault leaked out of any test here must fail THAT test's teardown,
+    not poison a sibling suite."""
+    yield
+    leaked = faults.armed()
+    faults.clear()
+    assert not leaked, "test left faults armed"
+
+
+@pytest.fixture
+def data(rng):
+    return rng.standard_normal((256, 16)).astype(np.float32)
+
+
+@pytest.fixture
+def queries(rng):
+    return rng.standard_normal((6, 16)).astype(np.float32)
+
+
+def bf_build(rows):
+    return brute_force.BruteForce().build(jnp.asarray(rows))
+
+
+def group(data, clock, *, n_replicas=2, policy=None, **kw):
+    return ReplicatedShard(
+        bf_build(data), n_replicas=n_replicas, delta_capacity=64,
+        policy=policy or FencingPolicy(max_consecutive=1, backoff_s=5.0),
+        clock=clock, name="g", **kw)
+
+
+# -- the fault registry ------------------------------------------------------
+
+def test_fire_disarmed_is_noop_and_counts_reset():
+    faults.fire("nothing/armed", foo=1)  # must not raise
+    with faults.scope():
+        faults.inject("p", exc=faults.FaultError("x"), times=1)
+        with pytest.raises(faults.FaultError):
+            faults.fire("p")
+        faults.fire("p")  # times=1 exhausted: no raise
+        assert faults.fired("p") == 1
+    assert not faults.armed() and faults.fired("p") == 0
+
+
+def test_after_match_and_callback():
+    seen = []
+    with faults.scope():
+        faults.inject("p", callback=seen.append, after=2,
+                      match=lambda ctx: ctx["who"] == "b")
+        for who in ("a", "b", "b", "a", "b", "b"):
+            faults.fire("p", who=who)
+        # 4 matching calls, first 2 skipped by after=2
+        assert [c["who"] for c in seen] == ["b", "b"]
+        assert seen[0]["point"] == "p"
+        assert faults.fired("p") == 2
+
+
+def test_stacked_injections_fire_in_order():
+    with faults.scope():
+        order = []
+        faults.inject("p", callback=lambda c: order.append(1), times=1)
+        faults.inject("p", exc=faults.FaultError("second"))
+        with pytest.raises(faults.FaultError):
+            faults.fire("p")
+        assert order == [1]
+
+
+# -- WriteAheadLog -----------------------------------------------------------
+
+def test_wal_roundtrip_upsert_delete(tmp_path, rng):
+    wal = WriteAheadLog(tmp_path / "w.log", name="t")
+    rows = rng.standard_normal((5, 8)).astype(np.float32)
+    ids = np.arange(100, 105, dtype=np.int64)
+    assert wal.append_upsert(rows, ids) == 1
+    assert wal.append_delete([101, 103]) == 2
+    wal.close()
+    back = list(WriteAheadLog(tmp_path / "w.log", name="t").replay())
+    assert [(s, k) for s, k, _, _ in back] == [(1, "upsert"), (2, "delete")]
+    np.testing.assert_array_equal(back[0][3], ids)
+    np.testing.assert_allclose(back[0][2], rows)
+    np.testing.assert_array_equal(back[1][3], [101, 103])
+
+
+def test_wal_preserves_byte_dtypes(tmp_path, rng):
+    wal = WriteAheadLog(tmp_path / "w.log")
+    rows = rng.integers(-128, 127, (3, 4), dtype=np.int8)
+    wal.append_upsert(rows, np.arange(3))
+    (_, _, got, _), = wal.replay()
+    assert got.dtype == np.int8
+    np.testing.assert_array_equal(got, rows)
+
+
+def test_wal_seq_continues_across_reopen(tmp_path, rng):
+    p = tmp_path / "w.log"
+    wal = WriteAheadLog(p)
+    wal.append_delete([1])
+    wal.append_delete([2])
+    wal.close()
+    wal2 = WriteAheadLog(p)
+    assert wal2.seq == 2
+    assert wal2.append_delete([3]) == 3  # numbering never restarts
+    assert [s for s, _, _, _ in wal2.replay()] == [1, 2, 3]
+    assert [s for s, _, _, _ in wal2.replay(after_seq=2)] == [3]
+
+
+def test_wal_torn_tail_tolerated_and_truncated(tmp_path, rng):
+    p = tmp_path / "w.log"
+    wal = WriteAheadLog(p)
+    rows = rng.standard_normal((4, 8)).astype(np.float32)
+    wal.append_upsert(rows, np.arange(4))
+    wal.append_delete([0])
+    wal.close()
+    good = os.path.getsize(p)
+    # a crash mid-append: garbage half-record at the tail
+    with open(p, "ab") as f:
+        f.write(b"\x01garbage-half-record")
+    wal2 = WriteAheadLog(p)
+    assert wal2.seq == 2  # torn record never acknowledged
+    recs = list(wal2.replay())
+    assert len(recs) == 2 and not wal2.last_scan["torn"]  # tail dropped
+    assert os.path.getsize(p) == good  # reopen truncated the garbage
+    assert wal2.append_delete([1]) == 3  # appends continue past it
+
+
+def test_wal_strict_replay_raises_on_corruption(tmp_path, rng):
+    p = tmp_path / "w.log"
+    wal = WriteAheadLog(p)
+    wal.append_delete([1])
+    wal.append_delete([2])
+    wal.close()
+    raw = bytearray(p.read_bytes())
+    raw[-3] ^= 0xFF  # flip a payload byte of the LAST record
+    p.write_bytes(bytes(raw))
+    wal2 = WriteAheadLog(p)
+    assert [s for s, _, _, _ in wal2.replay()] == [1]  # default: stop there
+    with pytest.raises(WalCorruptError):
+        list(wal2.replay(strict=True))
+    # appending past damage would be unreachable to replay — refused
+    with pytest.raises(WalCorruptError):
+        wal2.append_delete([3])
+    wal2.reset()  # explicit truncation (post-recovery snapshot) clears it
+    # the damaged record's seq was never replayable — its number is reused
+    assert wal2.append_delete([3]) == 2
+
+
+def test_wal_fsync_batching(tmp_path, rng):
+    wal = WriteAheadLog(tmp_path / "w.log", fsync_every=4)
+    with faults.scope():
+        faults.inject("wal/fsync", callback=lambda c: None)
+        for i in range(8):
+            wal.append_delete([i])
+        assert faults.fired("wal/fsync") == 2  # 8 appends / 4 per fsync
+        wal.append_delete([9])
+        wal.flush()  # 1 pending record -> forced sync
+        assert faults.fired("wal/fsync") == 3
+
+
+def test_wal_append_fault_mid_batch(tmp_path, rng):
+    """The k-th record of a burst fails: everything before it is durable,
+    the failed record was never written."""
+    wal = WriteAheadLog(tmp_path / "w.log")
+    with faults.scope():
+        faults.inject("wal/append", exc=faults.FaultError("disk full"),
+                      after=2, times=1)
+        wal.append_delete([1])
+        wal.append_delete([2])
+        with pytest.raises(faults.FaultError):
+            wal.append_delete([3])
+        wal.append_delete([4])
+    assert [s for s, _, _, _ in wal.replay()] == [1, 2, 3]
+    # seq 3 was REUSED by the post-failure append (the failed one never
+    # hit the file) — replay sees a contiguous, gap-free history
+    assert [list(i) for _, _, _, i in wal.replay()] == [[1], [2], [4]]
+
+
+def test_wal_reset_truncates_but_seq_continues(tmp_path):
+    wal = WriteAheadLog(tmp_path / "w.log")
+    wal.append_delete([1])
+    assert wal.size_bytes > 0
+    wal.reset()
+    assert wal.size_bytes == 0 and wal.seq == 1
+    assert wal.append_delete([2]) == 2
+    assert [s for s, _, _, _ in wal.replay()] == [2]
+
+
+# -- MutableIndex + WAL: the crash windows ----------------------------------
+
+def test_fresh_wrap_refuses_nonempty_wal(tmp_path, data):
+    p = tmp_path / "w.log"
+    wal = WriteAheadLog(p)
+    wal.append_delete([1])
+    wal.close()
+    with pytest.raises(RaftError, match="already holds records"):
+        MutableIndex(bf_build(data), wal=p)
+
+
+def test_crash_between_wal_and_memtable_recovers(tmp_path, data, queries,
+                                                 rng):
+    """The tentpole acceptance path: crash after the WAL append but before
+    the memtable insert — load + replay recovers every logged write with
+    recall parity against an uncrashed twin."""
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    m = MutableIndex(bf_build(data), delta_capacity=64, wal=wpath,
+                     snapshot_path=snap)
+    stream.save(m, snap)  # baseline snapshot (truncates the empty log)
+    rows1 = rng.standard_normal((8, 16)).astype(np.float32)
+    rows2 = rng.standard_normal((4, 16)).astype(np.float32)
+    m.upsert(rows1)
+    m.delete([3, 5, 250])
+    with faults.scope():
+        faults.inject("stream/post-wal", faults.SimulatedCrash("kill -9"))
+        with pytest.raises(faults.SimulatedCrash):
+            m.upsert(rows2)
+    del m  # the process is gone; only snap + wal.log survive
+
+    twin = MutableIndex(bf_build(data), delta_capacity=64)
+    twin.upsert(rows1)
+    twin.delete([3, 5, 250])
+    twin.upsert(rows2)  # the logged write replays, so the twin applies it
+
+    rec = stream.load(snap, wal=wpath)
+    assert rec.last_recovery == {"replayed": 3, "skipped": 0,
+                                 "torn": False, "wal_seq": 3}
+    dr, ir = rec.search(queries, 10)
+    dt, it = twin.search(queries, 10)
+    np.testing.assert_array_equal(np.asarray(ir), np.asarray(it))
+    np.testing.assert_allclose(np.asarray(dr), np.asarray(dt), rtol=1e-5)
+    assert rec.size == twin.size
+    # the log re-attached: new writes are durable and replayable
+    rec.upsert(rng.standard_normal((2, 16)).astype(np.float32))
+    assert rec._wal.seq == 4
+
+
+def test_snapshot_covers_log_and_replay_skips(tmp_path, data, rng):
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    m = MutableIndex(bf_build(data), delta_capacity=64, wal=wpath)
+    m.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    stream.save(m, snap)  # snapshot covers seq 1; log truncates
+    assert m._wal.size_bytes == 0
+    m.delete([0])  # seq 2, only in the log
+    rec = stream.load(snap, wal=wpath)
+    # only the post-snapshot record replays
+    assert rec.last_recovery["replayed"] == 1
+    assert rec.last_recovery["wal_seq"] == 2
+    assert rec.size == m.size
+
+
+def test_compaction_swap_truncates_wal(tmp_path, data, rng):
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    m = MutableIndex(bf_build(data), delta_capacity=64, wal=wpath,
+                     snapshot_path=snap)
+    m.upsert(rng.standard_normal((8, 16)).astype(np.float32))
+    assert m._wal.size_bytes > 0
+    report = m.compact()
+    assert report["snapshot"] == snap
+    assert m._wal.size_bytes == 0  # the snapshot now covers the log
+    rec = stream.load(snap, wal=wpath)
+    assert rec.last_recovery["replayed"] == 0
+    assert rec.size == m.size
+
+
+def test_crashed_save_keeps_previous_snapshot(tmp_path, data, queries, rng):
+    """Satellite: a fault-injected crash mid-save (after the temp write,
+    before the rename) leaves the previous snapshot readable AND the WAL
+    untruncated — nothing acknowledged is lost."""
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    m = MutableIndex(bf_build(data), delta_capacity=64, wal=wpath)
+    stream.save(m, snap)
+    m.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    before = m.search(queries, 10)
+    with faults.scope():
+        faults.inject("serialize/atomic-write",
+                      faults.SimulatedCrash("kill -9"))
+        with pytest.raises(faults.SimulatedCrash):
+            stream.save(m, snap)
+    assert m._wal.size_bytes > 0  # crash BEFORE rename: log kept
+    rec = stream.load(snap, wal=wpath)  # previous snapshot + full replay
+    assert rec.last_recovery["replayed"] == 1
+    got = rec.search(queries, 10)
+    np.testing.assert_array_equal(np.asarray(got[1]),
+                                  np.asarray(before[1]))
+    assert not any(f.startswith("snap.bin.tmp")
+                   for f in os.listdir(tmp_path))  # temp cleaned up
+
+
+def test_plain_index_save_is_atomic(tmp_path, data):
+    """Satellite: the sealed-index save paths ride atomic_write too — a
+    crashed save leaves the previous file loadable."""
+    p = str(tmp_path / "bf.bin")
+    idx = bf_build(data)
+    brute_force.save(idx, p)
+    with faults.scope():
+        faults.inject("serialize/atomic-write",
+                      faults.SimulatedCrash("kill -9"))
+        with pytest.raises(faults.SimulatedCrash):
+            brute_force.save(bf_build(data[:32]), p)
+    back = brute_force.load(p)
+    assert back.dataset.shape == (data.shape[0], data.shape[1])
+
+
+# -- ReplicatedShard: failover ----------------------------------------------
+
+def test_replicas_lockstep_and_r1_parity(data, queries, rng):
+    clock = FakeClock()
+    g = group(data, clock)
+    single = MutableIndex(bf_build(data), delta_capacity=64)
+    rows = rng.standard_normal((8, 16)).astype(np.float32)
+    g.upsert(rows)
+    single.upsert(rows)
+    g.delete([1, 2])
+    single.delete([1, 2])
+    assert [r.size for r in g.replicas] == [single.size, single.size]
+    dg, ig = g.search(queries, 10)
+    ds, is_ = single.search(queries, 10)
+    np.testing.assert_array_equal(np.asarray(ig), np.asarray(is_))
+    np.testing.assert_allclose(np.asarray(dg), np.asarray(ds), rtol=1e-6)
+
+
+def test_read_failover_same_call(data, queries):
+    clock = FakeClock()
+    g = group(data, clock)
+    want = np.asarray(g.search(queries, 5)[1])
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"].endswith("/r0"))
+        got = np.asarray(g.search(queries, 5)[1])  # must not raise
+        assert faults.fired("replica/search") >= 1
+    np.testing.assert_array_equal(got, want)
+    h = g.health()
+    r0 = next(r for r in h["replicas"] if r["replica"].endswith("/r0"))
+    assert r0["fenced"] and not r0["stale"]
+    assert "FaultError" in r0["last_error"]
+
+
+def test_breaker_opens_after_consecutive_strikes(data, queries):
+    clock = FakeClock()
+    g = group(data, clock,
+              policy=FencingPolicy(max_consecutive=2, backoff_s=5.0))
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"].endswith("/r0"))
+        # r0 is struck at most once per search (the failover moves on);
+        # the breaker stays closed until max_consecutive strikes accrue
+        while g._health[0].consecutive < 1:
+            g.search(queries, 5)
+        assert g.health()["healthy"] == 2  # one strike: not fenced yet
+        while g._health[0].consecutive < 2:
+            g.search(queries, 5)
+        assert g.health()["healthy"] == 1  # breaker open
+        # fenced r0 is not picked at all now — no new fires
+        n = faults.fired("replica/search")
+        g.search(queries, 5)
+        assert faults.fired("replica/search") == n
+
+
+def test_probe_heals_and_failed_probe_doubles_backoff(data, queries):
+    clock = FakeClock()
+    g = group(data, clock)  # max_consecutive=1, backoff 5s
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"].endswith("/r0"))
+        while g._health[0].fenced_until is None:
+            g.search(queries, 5)  # strike fences r0 until t=5
+        assert g._health[0].fenced_until == pytest.approx(5.0)
+        clock.advance(6.0)  # half-open: the NEXT pick probes r0 first
+        g.search(queries, 5)  # probe fails -> re-fence, doubled backoff
+        assert g._health[0].fenced_until == pytest.approx(6.0 + 10.0)
+    clock.advance(11.0)  # past the doubled fence; fault cleared: probe ok
+    g.search(queries, 5)
+    assert g.health()["healthy"] == 2
+    assert g._health[0].backoff == 5.0  # success re-bases the backoff
+
+
+def test_wedged_replica_slow_strike_no_wall_sleep(data, queries):
+    """A hang is simulated by a callback advancing the injected clock past
+    the deadline: the scan 'takes' 10s, the result is still returned
+    (valid), and the breaker fences the replica for future picks."""
+    clock = FakeClock()
+    g = group(data, clock,
+              policy=FencingPolicy(deadline_s=0.5, max_consecutive=1,
+                                   backoff_s=5.0))
+    want = np.asarray(g.search(queries, 5)[1])
+    with faults.scope():
+        # whichever replica the pick lands on 'hangs': the injected clock
+        # jumps past deadline_s during its scan — no wall sleep anywhere
+        faults.inject("replica/search",
+                      callback=lambda c: clock.advance(10.0), times=1)
+        got = np.asarray(g.search(queries, 5)[1])
+    np.testing.assert_array_equal(got, want)  # the slow result is valid
+    h = g.health()
+    assert sum(1 for r in h["replicas"] if r["fenced"]) == 1
+
+
+def test_write_failure_marks_stale_not_lost(data, queries, rng):
+    clock = FakeClock()
+    g = group(data, clock)
+    rows = rng.standard_normal((4, 16)).astype(np.float32)
+    with faults.scope():
+        faults.inject("replica/upsert", exc=faults.FaultError("dev fault"),
+                      match=lambda c: c["replica"].endswith("/r1"),
+                      times=1)
+        gids = g.upsert(rows)  # succeeds: r0 applied it
+    assert g.stats()["stale"] == 1
+    assert g.replicas[0].size == data.shape[0] + 4
+    # reads NEVER touch the stale twin (it would un-acknowledge the write)
+    _, ids = g.search(rows[:1], 1)
+    assert int(np.asarray(ids)[0, 0]) == int(gids[0])
+    # later writes skip the stale twin instead of diverging it further
+    g.upsert(rng.standard_normal((2, 16)).astype(np.float32))
+    assert g.replicas[0].size == g.replicas[1].size + 6
+    clock.advance(100.0)  # stale is permanent: backoff cannot heal it
+    assert g.stats()["stale"] == 1 and g.stats()["healthy"] == 1
+
+
+def test_all_replicas_out_raises_structured(data, queries):
+    clock = FakeClock()
+    g = group(data, clock)
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"))
+        with pytest.raises(ReplicaUnavailableError) as ei:
+            g.search(queries, 5)
+    assert ei.value.name == "g" and ei.value.replicas == 2
+    assert ei.value.fenced == 2
+    assert isinstance(ei.value.__cause__, faults.FaultError)
+    # both fenced now; past the backoff the group heals
+    clock.advance(6.0)
+    assert np.asarray(g.search(queries, 5)[0]).shape == (6, 5)
+
+
+def test_group_admission_whole_or_nothing(data, rng):
+    clock = FakeClock()
+    g = ReplicatedShard(bf_build(data), n_replicas=2, delta_capacity=8,
+                        clock=clock, name="g")
+    g.upsert(rng.standard_normal((6, 16)).astype(np.float32))
+    with pytest.raises(stream.DeltaFullError):
+        g.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    # nothing landed anywhere — both twins still at 6 delta rows
+    assert [r.stats()["delta_rows"] for r in g.replicas] == [6, 6]
+
+
+def test_all_stale_group_refuses_writes(data, rng):
+    """With EVERY twin stale a write must refuse loudly — acknowledging
+    it with no twin (and no WAL record) to hold it would lose it
+    silently."""
+    clock = FakeClock()
+    g = group(data, clock)
+    rows = rng.standard_normal((4, 16)).astype(np.float32)
+    with faults.scope():
+        faults.inject("replica/upsert", exc=faults.FaultError("dev fault"))
+        with pytest.raises(faults.FaultError):
+            g.upsert(rows)  # all twins fail -> both stale, write raises
+    assert g.stats()["stale"] == 2
+    with pytest.raises(ReplicaUnavailableError):
+        g.upsert(rows)
+    with pytest.raises(ReplicaUnavailableError):
+        g.delete([0, 1])
+
+
+def test_failed_group_write_rolls_back_wal(tmp_path, data, rng):
+    """A write that failed on EVERY twin raised to the caller — its WAL
+    record must not survive to resurrect the write at recovery."""
+    clock = FakeClock()
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    g = group(data, clock, wal=wpath, snapshot_path=snap)
+    g.save(snap)
+    g.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    seq_before, size_before = g._wal.seq, g._wal.size_bytes
+    with faults.scope():
+        faults.inject("replica/upsert", exc=faults.FaultError("dev fault"))
+        with pytest.raises(faults.FaultError):
+            g.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    assert g._wal.seq == seq_before
+    assert g._wal.size_bytes == size_before
+    rec = stream.load(snap, wal=wpath)
+    assert rec.last_recovery["replayed"] == 1  # the acknowledged write only
+    assert rec.size == data.shape[0] + 4
+
+
+def test_validation_error_does_not_strike(data, queries):
+    """A deterministic client error (bad query dim) must raise without
+    striking the breaker — a few malformed requests must never fence the
+    whole group and fail subsequent VALID queries."""
+    clock = FakeClock()
+    g = group(data, clock)
+    bad = np.zeros((3, 7), np.float32)  # wrong dim (16 expected)
+    for _ in range(3):
+        with pytest.raises(Exception) as ei:
+            g.search(bad, 5)
+        assert not isinstance(ei.value, ReplicaUnavailableError)
+    h = g.health()
+    assert all(r["strikes_total"] == 0 and not r["fenced"]
+               for r in h["replicas"]), h
+    assert np.asarray(g.search(queries, 5)[0]).shape == (6, 5)
+
+
+def test_replica_devices_must_not_collide(data):
+    """devices= with fewer devices than replicas would co-locate twins of
+    one shard — silently voiding the anti-affinity the groups promise."""
+    import jax
+
+    with pytest.raises(RaftError, match="anti-affinity"):
+        ShardedMutableIndex(data, n_shards=2, build=bf_build, replicas=3,
+                            delta_capacity=64,
+                            devices=jax.devices()[:2])
+
+
+def test_group_wal_durability(tmp_path, data, queries, rng):
+    """Group-level WAL: the log is written once for the group; recovery is
+    a degraded-to-one stream.load that holds every acknowledged write."""
+    clock = FakeClock()
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    g = group(data, clock, wal=wpath, snapshot_path=snap)
+    g.save(snap)
+    rows = rng.standard_normal((8, 16)).astype(np.float32)
+    gids = g.upsert(rows)
+    g.delete(gids[:2].tolist())
+    rec = stream.load(snap, wal=wpath)
+    assert rec.last_recovery["replayed"] == 2
+    assert rec.size == g.size
+    dr, ir = rec.search(queries, 10)
+    dg, ig = g.search(queries, 10)
+    np.testing.assert_array_equal(np.asarray(ir), np.asarray(ig))
+
+
+def test_group_save_truncates_and_compact_snapshots(tmp_path, data, rng):
+    clock = FakeClock()
+    snap = str(tmp_path / "snap.bin")
+    wpath = str(tmp_path / "wal.log")
+    g = group(data, clock, wal=wpath, snapshot_path=snap)
+    g.upsert(rng.standard_normal((4, 16)).astype(np.float32))
+    assert g._wal.size_bytes > 0
+    report = g.compact()
+    assert report["snapshot"] == snap and len(report["replica_wall_s"]) == 2
+    assert g._wal.size_bytes == 0
+    rec = stream.load(snap, wal=wpath)
+    assert rec.last_recovery["replayed"] == 0 and rec.size == g.size
+
+
+# -- sharded mesh with replica groups ---------------------------------------
+
+def test_mesh_replica_parity_and_one_dead_replica(data, queries, rng):
+    clock = FakeClock()
+    sm = ShardedMutableIndex(
+        data, n_shards=3, build=bf_build, replicas=2, delta_capacity=64,
+        fencing=FencingPolicy(max_consecutive=1, backoff_s=5.0),
+        clock=clock, name="mesh")
+    plain = ShardedMutableIndex(data, n_shards=3, build=bf_build,
+                                delta_capacity=64, name="plainmesh")
+    rows = rng.standard_normal((12, 16)).astype(np.float32)
+    sm.upsert(rows)
+    plain.upsert(rows)
+    sm.delete([3, 7])
+    plain.delete([3, 7])
+    want = np.asarray(plain.search(queries, 10)[1])
+    np.testing.assert_array_equal(np.asarray(sm.search(queries, 10)[1]),
+                                  want)
+    with faults.scope():
+        # kill shard 1's replica 0 outright: EVERY query must still answer
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"] == "mesh/shard1/r0")
+        for _ in range(4):
+            got = np.asarray(sm.search(queries, 10)[1])
+            np.testing.assert_array_equal(got, want)
+    h = sm.health()
+    assert h["healthy_min"] >= 1
+    st = sm.stats()
+    assert st["replicas"] == 6 and st["shards"] == 3
+
+
+def test_mesh_staggered_compact_with_replicas(data, rng, queries):
+    clock = FakeClock()
+    sm = ShardedMutableIndex(data, n_shards=2, build=bf_build, replicas=2,
+                             delta_capacity=32, clock=clock, name="m2")
+    sm.upsert(rng.standard_normal((8, 16)).astype(np.float32))
+    report = sm.compact()
+    assert "shard" in report and len(report["replica_wall_s"]) == 2
+    assert np.asarray(sm.search(queries, 10)[0]).shape == (6, 10)
+
+
+def test_mesh_hook_serves_through_failover(data, queries, rng):
+    clock = FakeClock()
+    sm = ShardedMutableIndex(
+        data, n_shards=2, build=bf_build, replicas=2, delta_capacity=64,
+        fencing=FencingPolicy(max_consecutive=1, backoff_s=5.0),
+        clock=clock, name="hookmesh")
+    hook = sm.searcher()
+    want = np.asarray(hook(queries, 10)[1])
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"].endswith("shard0/r0"))
+        got = np.asarray(hook(queries, 10)[1])  # issued BEFORE the fence
+    np.testing.assert_array_equal(got, want)
+
+
+# -- /healthz replica verdict ------------------------------------------------
+
+def test_healthz_folds_replica_health(data, queries):
+    from raft_tpu.obs.http import _fold_replica_health
+
+    clock = FakeClock()
+    g = group(data, clock)
+    code, body = _fold_replica_health(200, {"status": "ready"}, g.health())
+    assert (code, body["status"]) == (200, "ready")
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"),
+                      match=lambda c: c["replica"].endswith("/r0"))
+        while g._health[0].fenced_until is None:
+            g.search(queries, 5)
+    code, body = _fold_replica_health(200, {"status": "ready"}, g.health())
+    assert (code, body["status"]) == (200, "degraded")  # capacity down
+    # a failing SLO verdict is never upgraded by healthy replicas
+    code, body = _fold_replica_health(503, {"status": "failing"},
+                                      g.health())
+    assert (code, body["status"]) == (503, "failing")
+    with faults.scope():
+        faults.inject("replica/search", exc=faults.FaultError("dead"))
+        with pytest.raises(ReplicaUnavailableError):
+            g.search(queries, 5)
+    code, body = _fold_replica_health(200, {"status": "ready"}, g.health())
+    assert (code, body["status"]) == (503, "failing")  # zero pickable
+
+
+def test_healthz_endpoint_serves_replica_detail(data):
+    from raft_tpu.obs.http import MetricsExporter
+    from urllib.request import urlopen
+    import json
+
+    clock = FakeClock()
+    g = group(data, clock)
+    with MetricsExporter(port=0, replicas=g) as exp:
+        raw = urlopen(f"http://127.0.0.1:{exp.port}/healthz",
+                      timeout=5).read()
+    body = json.loads(raw)
+    assert body["status"] == "ready"
+    assert [r["fenced"] for r in body["replicas"]["replicas"]] == \
+        [False, False]
+
+
+# -- submit_with_retry -------------------------------------------------------
+
+class _ScriptedService:
+    """Raises the scripted errors in order, then admits."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def submit(self, name, queries, k, timeout_s=None):
+        self.calls.append(timeout_s)
+        if self.script:
+            err = self.script.pop(0)
+            if err is not None:
+                raise err
+        return "future"
+
+
+def test_retry_backs_off_then_admits():
+    clock, sleeps = FakeClock(), []
+
+    def sleep(dt):
+        sleeps.append(dt)
+        clock.advance(dt)
+
+    svc = _ScriptedService([OverloadedError("full"), OverloadedError("full"),
+                            None])
+    rng = __import__("random").Random(7)
+    fut = submit_with_retry(svc, "main", None, 5, base_s=0.01, jitter=0.5,
+                            clock=clock, sleep=sleep, rng=rng)
+    assert fut == "future" and len(svc.calls) == 3
+    # exponential base with +-50% jitter: sleep n in [cap/2, 3cap/2]
+    assert 0.005 <= sleeps[0] <= 0.015
+    assert 0.01 <= sleeps[1] <= 0.03
+
+
+def test_retry_never_retries_deadline():
+    svc = _ScriptedService([DeadlineExceededError("late"), None])
+    with pytest.raises(DeadlineExceededError):
+        submit_with_retry(svc, "main", None, 5, sleep=lambda dt: None)
+    assert len(svc.calls) == 1
+
+
+def test_retry_exhausts_with_last_refusal():
+    svc = _ScriptedService([OverloadedError(f"full {i}") for i in range(9)])
+    with pytest.raises(OverloadedError, match="full 2"):
+        submit_with_retry(svc, "main", None, 5, max_attempts=3,
+                          sleep=lambda dt: None)
+    assert len(svc.calls) == 3
+
+
+def test_retry_respects_deadline_budget():
+    clock = FakeClock()
+
+    def sleep(dt):
+        clock.advance(dt)
+
+    # backoff would cross the deadline: DeadlineExceeded WITHOUT sleeping
+    svc = _ScriptedService([OverloadedError("full")] * 5)
+    with pytest.raises(DeadlineExceededError):
+        submit_with_retry(svc, "main", None, 5, timeout_s=0.001,
+                          base_s=1.0, jitter=0.0, clock=clock, sleep=sleep)
+    assert clock.t == 0.0  # never slept into the spent budget
+    assert len(svc.calls) == 1
+    # remaining budget shrinks across attempts
+    svc2 = _ScriptedService([OverloadedError("full"), None])
+    submit_with_retry(svc2, "main", None, 5, timeout_s=10.0, base_s=0.5,
+                      jitter=0.0, clock=clock, sleep=sleep)
+    assert svc2.calls[0] == pytest.approx(10.0)
+    assert svc2.calls[1] == pytest.approx(9.5)
+
+
+def test_retry_against_real_service(data):
+    """End-to-end: a 1-slot queue refuses the second submit; the retry
+    admits it after the first flush drains (injected clock, pump-driven)."""
+    clock = FakeClock()
+    svc = SearchService(max_batch=2, max_wait_us=1.0, max_queue_rows=2,
+                        clock=clock, start_workers=False)
+    svc.publish("main", bf_build(data), k=5, warm=False)
+    q = data[:2]
+    f1 = svc.submit("main", q, 5)
+
+    def sleep(dt):
+        clock.advance(dt)
+        svc.pump()  # the drain that clears the overload
+
+    f2 = submit_with_retry(svc, "main", q, 5, base_s=0.001,
+                           clock=clock, sleep=sleep)
+    clock.advance(1.0)
+    svc.pump()
+    assert f1.result(timeout=0)[0].shape == (2, 5)
+    assert f2.result(timeout=0)[0].shape == (2, 5)
+    svc.shutdown()

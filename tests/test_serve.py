@@ -255,6 +255,65 @@ def test_swap_retires_old_version_after_lease_drain(bf, dataset):
     assert v1.searcher is None
 
 
+def test_lease_survives_retire_while_publish_mints_new_version(bf, dataset):
+    """ISSUE 11 satellite: a lease held across a retire-after-drain while
+    a CONCURRENT publish mints a new version. v1's lease is held while v2
+    replaces v1 and v3 replaces v2 — v2 (unleased) retires inside v3's
+    publish while v1 is still draining; the old lease must stay usable
+    throughout and v1 must retire exactly at its release, untouched by
+    the sibling retirement."""
+    reg = IndexRegistry(buckets=(1,))
+    reg.publish("main", bf, k=5, warm=False)
+    v1 = reg.active("main")
+    with reg.lease("main") as leased:
+        reg.publish("main", brute_force.BruteForce().build(dataset),
+                    k=5, warm=False)
+        v2 = reg.active("main")
+        reg.publish("main", brute_force.BruteForce().build(dataset),
+                    k=5, warm=False)
+        # v2 retired the moment v3 replaced it (zero leases); v1 still
+        # drains on its lease; v3 is active
+        assert reg.live_versions("main") == (1, 3)
+        assert v2.searcher is None
+        assert leased is v1 and leased.searcher is not None
+        d, i = leased.searcher(dataset[:1], 5)
+        assert np.asarray(i).shape == (1, 5)
+    assert reg.live_versions("main") == (3,)
+    assert v1.searcher is None  # released -> retired, arrays droppable
+
+
+def test_raising_searcher_releases_lease_and_version_retires(bf, dataset):
+    """ISSUE 11 satellite: a searcher that raises mid-flush must leave its
+    lease RELEASED (the flush's lease is a context manager, but the gap
+    was untested) so the version stays retirable — a leaked lease would
+    pin the broken index's arrays forever."""
+    from raft_tpu.neighbors._hooks import make_hook
+
+    calls = []
+
+    def boom(queries, k):
+        calls.append(len(queries))
+        raise RuntimeError("device fault mid-flush")
+
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, max_wait_us=1.0, max_queue_rows=32,
+                        clock=clock, start_workers=False)
+    svc.publish("main", make_hook(boom, "custom", 16), k=5, warm=False)
+    v1 = svc.registry.active("main")
+    fut = svc.submit("main", dataset[:2], 5)
+    clock.advance(1.0)
+    svc.pump()
+    with pytest.raises(RuntimeError, match="device fault"):
+        fut.result(timeout=0)
+    assert calls == [2] and v1.leases == 0  # lease released on the raise
+    # the broken version is retirable: a republish drops it immediately
+    svc.publish("main", make_hook(lambda q, k: boom(q, k), "custom", 16),
+                k=5, warm=False)
+    assert svc.registry.live_versions("main") == (2,)
+    assert v1.searcher is None
+    svc.shutdown()
+
+
 def test_version_numbers_monotonic(bf):
     reg = IndexRegistry(buckets=(1,))
     reg.publish("main", bf, warm=False)
