@@ -39,6 +39,22 @@ archives per round:
                                  hot-swap proof (swap.failed == 0,
                                  swap.compile_s == 0). `--serve` runs ONLY
                                  this row (parameter iteration loop).
+  serve_pipeline_100k            host-free flush pipeline A/B (ISSUE 12):
+                                 the SAME closed-loop threaded load served
+                                 synchronously (pipeline_depth=0, the
+                                 BENCH_r05-era flush) vs pipelined
+                                 (bounded in-flight completion + pinned
+                                 double-buffered staging with donation) —
+                                 per-flush QPS and p50/p99 both modes at
+                                 identical recall, the queue-wait vs
+                                 flush-time decomposition per mode (the
+                                 win must land on the flush side), mean
+                                 dispatches per flush, zero failed
+                                 queries, zero cold compiles across the
+                                 pipelined window, and flat staging-ledger
+                                 bytes across post-load waves (donation
+                                 returns the previous query buffer).
+                                 `--serve-pipeline` runs ONLY this row.
   serve_churn_ivf_pq_100k        raft_tpu.stream churn row: closed-loop
                                  mixed read/write load on a
                                  MutableIndex(ivf_pq) — p50/p99 search
@@ -820,6 +836,218 @@ def _row_serve(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
             "cache_misses": serving_rec.cache_misses,
         },
         "failures": failures[:5],
+    })
+
+
+def _row_serve_pipeline(rows, n=100_000, d=128, n_lists=1024, pq_dim=64,
+                        k=10, n_probes=8, threads=8, per_thread=300,
+                        max_batch=64, max_wait_us=2000.0, ncl=2000,
+                        depth=2, waves=3):
+    """Host-free flush pipeline A/B (ISSUE 12): the same closed-loop
+    threaded load through SearchService served with the synchronous flush
+    (``pipeline_depth=0`` — the batcher blocks on the device per flush,
+    the BENCH_r05-era protocol) vs the pipelined flush (bounded in-flight
+    completion stage + pinned double-buffered staging with donation).
+
+    The acceptance set rides in the row:
+
+    - ``pipelined_over_sync`` — per-flush QPS ratio at identical recall
+      (same index, same query pool, both modes' recall in the row);
+    - ``decomp`` — the PR 7 split histograms per mode: a request's p99
+      decomposes into queue wait + flush share, and the pipeline's win
+      must land on the FLUSH side (overlapped H2D/compute/D2H), not on
+      queue accounting;
+    - ``dispatches_per_flush_mean`` — the obs.dispatch fusion meter
+      (pipelined mode; the sync flush materializes inline and records
+      none);
+    - zero failed queries both modes and ZERO cold compiles across the
+      whole pipelined loaded window (publish warmed the bucket ladder,
+      the committed-placement executables, and the per-bucket donated
+      stage programs before the first flush);
+    - ``staging`` — uploads/donation-frees counters plus per-wave
+      samples across ``waves`` post-load single-bucket waves: the
+      ledger's accounted staging bytes stay FLAT (the footprint is
+      constant by design — one slot per bucket) while
+      ``donation_frees`` ADVANCES every wave, i.e. XLA actually deleted
+      the previous flush's query buffer on every donated upload (the
+      frees counter, fed by ``is_deleted()``, is the observation that
+      donation works; a backend that ignored ``donate_argnums`` would
+      flatline it).
+    """
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import mem as obs_mem
+    from raft_tpu.obs import metrics as obs_metrics
+    from raft_tpu.serve import SearchService
+
+    _note("pipeline: dataset")
+    dataset, qsets = _make_clustered(n, d, max(threads * per_thread, 1000),
+                                     ncl, n_qsets=1, seed=13)
+    jax.block_until_ready([dataset] + qsets)
+    _note("pipeline: ground truth")
+    gt = _ground_truth(dataset, qsets[0][:1000], k=k)
+    # host copy: single-row slices per request must not compile per offset
+    pool = np.asarray(qsets[0])
+
+    _note("pipeline: ivf_pq build")
+    params = ivf_pq.IndexParams(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim,
+                                seed=0)
+    idx = ivf_pq.build(params, dataset)
+    jax.block_until_ready(idx.list_codes)
+    sp = ivf_pq.SearchParams(n_probes=n_probes, lut_dtype="bfloat16")
+
+    # the flagship composed pipeline (PQ candidates at 4k + exact refine),
+    # published as a custom hook — the same serving surface _row_serve uses
+    def hook():
+        from raft_tpu.neighbors.refine import refine
+
+        def fn(queries, k_):
+            _, cand = ivf_pq.search(sp, idx, queries, 4 * k_)
+            return refine(dataset, queries, cand, k_)
+
+        fn.kind, fn.dim, fn.query_dtype = "ivf_pq+refine", d, "float32"
+        return fn
+
+    stream = f"pipe.k{k}"
+    n_req = threads * per_thread
+
+    def load(svc):
+        """One closed-loop window — identical protocol both modes."""
+        lats, results, failures = [], {}, []
+        lock = threading.Lock()
+
+        def submitter(tid):
+            my_lats, my_res = [], {}
+            for j in range(per_thread):
+                qi = (tid + j * threads) % pool.shape[0]
+                t0 = time.perf_counter()
+                try:
+                    _, ids = svc.search("pipe", pool[qi:qi + 1], k)
+                except Exception as e:  # pragma: no cover - fails the row
+                    with lock:
+                        failures.append(f"{type(e).__name__}: {str(e)[:80]}")
+                    continue
+                my_lats.append(time.perf_counter() - t0)
+                if qi < 1000:
+                    my_res[qi] = np.asarray(ids)[0]
+            with lock:
+                lats.extend(my_lats)
+                results.update(my_res)
+
+        before = obs_metrics.to_json()
+        workers = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(threads)]
+        t_load = time.perf_counter()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(600)
+        load_s = time.perf_counter() - t_load
+        delta = obs_metrics.delta(before, obs_metrics.to_json())
+
+        def hist_ms(nm):
+            s = delta.get('raft_tpu_serve_%s_sum{stream="%s"}'
+                          % (nm, stream), 0.0)
+            c = delta.get('raft_tpu_serve_%s_count{stream="%s"}'
+                          % (nm, stream), 0)
+            return round(1e3 * s / max(c, 1), 3)
+
+        lats_ms = np.sort(np.array(lats if lats else [0.0])) * 1e3
+        recall = None
+        if results:  # pragma: no branch - losses already fail the row
+            got = np.stack([results[i] for i in sorted(results)])
+            recall = round(_recall(got, gt[sorted(results)]), 4)
+        disp_c = delta.get(
+            'raft_tpu_serve_dispatches_per_flush_count{stream="%s"}'
+            % stream, 0)
+        disp_s = delta.get(
+            'raft_tpu_serve_dispatches_per_flush_sum{stream="%s"}'
+            % stream, 0.0)
+        return {
+            "qps": round((n_req - len(failures)) / load_s, 1),
+            "p50_ms": round(float(lats_ms[len(lats_ms) // 2]), 3),
+            "p99_ms": round(float(lats_ms[int(len(lats_ms) * 0.99) - 1]), 3),
+            "recall": recall, "failed": len(failures),
+            "failures": failures[:5],
+            "queue_wait_ms_mean": hist_ms("queue_wait_seconds"),
+            "flush_ms_mean": hist_ms("flush_seconds"),
+            "dispatches_per_flush_mean":
+                round(disp_s / disp_c, 2) if disp_c else None,
+        }
+
+    _note("pipeline: sync (depth=0) closed loop, %d threads" % threads)
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max(4 * max_batch * threads, 256),
+                        pipeline_depth=0)
+    svc.publish("pipe", hook(), k=k)
+    sync = load(svc)
+    svc.shutdown()
+
+    _note("pipeline: pipelined (depth=%d) closed loop" % depth)
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=max(4 * max_batch * threads, 256),
+                        pipeline_depth=depth,
+                        staging_device=jax.devices()[0])
+    report = svc.publish("pipe", hook(), k=k)
+    with obs_compile.attribution() as rec:
+        piped = load(svc)
+        # donation/no-growth proof: serial single-row waves AFTER the load
+        # (bucket-1 flushes only, every slot long since resident) — the
+        # accounted staging bytes stay FLAT while donation_frees ADVANCES
+        # every wave (the previous buffer actually deleted per upload)
+        levels = []
+        for _ in range(waves):
+            for j in range(2 * max_batch):
+                svc.search("pipe", pool[j:j + 1], k)
+            ent = [e for e in obs_mem.breakdown()
+                   if e["component"] == "serve/staging"
+                   and e["name"] == stream]
+            stw = svc.staging_stats().get(stream, {})
+            levels.append({
+                "ledger_bytes": (int(ent[0]["device_bytes"]
+                                     + ent[0]["host_bytes"])
+                                 if ent else -1),
+                "donation_frees": stw.get("donation_frees", -1),
+                "uploads": stw.get("uploads", -1),
+            })
+    staging = dict(svc.staging_stats().get(stream, {}))
+    staging["by_wave"] = levels
+    svc.shutdown()
+
+    rows.append({
+        "name": "serve_pipeline_100k",
+        "qps": piped["qps"],
+        "p50_ms": piped["p50_ms"], "p99_ms": piped["p99_ms"],
+        "recall": piped["recall"],
+        "sync_qps": sync["qps"],
+        "sync_p50_ms": sync["p50_ms"], "sync_p99_ms": sync["p99_ms"],
+        "sync_recall": sync["recall"],
+        "pipelined_over_sync": round(
+            piped["qps"] / max(sync["qps"], 1e-9), 3),
+        "decomp": {
+            mode: {"queue_wait_ms_mean": r["queue_wait_ms_mean"],
+                   "flush_ms_mean": r["flush_ms_mean"]}
+            for mode, r in (("sync", sync), ("pipelined", piped))},
+        "dispatches_per_flush_mean": piped["dispatches_per_flush_mean"],
+        "staging": staging,
+        "failed": sync["failed"] + piped["failed"],
+        "failures": (sync["failures"] + piped["failures"])[:5],
+        "pipeline": {
+            "depth": depth,
+            "staging_warmed": report.get("staging_warmed"),
+            # zero-cold-compile proof for the WHOLE pipelined window
+            # (load + the ledger waves): publish warmed the ladder, the
+            # committed placements, and the donated stage programs
+            "compile_s": round(rec.compile_s, 3),
+            "cache_misses": rec.cache_misses,
+        },
+        "threads": threads, "max_batch": max_batch,
+        "max_wait_us": max_wait_us,
     })
 
 
@@ -2166,6 +2394,11 @@ def _run(rows):
         _emit()
 
     if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "serve_pipeline_100k",
+                   lambda: _row_serve_pipeline(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "serve_churn_ivf_pq_100k",
                    lambda: _row_serve_churn(rows))
         _emit()
@@ -2316,6 +2549,13 @@ def main(argv=None):
             _setup(rows)
             _row_guard(rows, "tune_smoke_10k",
                        lambda: _row_tune_smoke(rows))
+        elif "--serve-pipeline" in argv:
+            # host-free flush pipeline A/B only (ISSUE 12): the iteration
+            # loop for pipeline_depth / staging parameters — sync vs
+            # pipelined per-flush QPS with the queue/flush decomposition
+            _setup(rows)
+            _row_guard(rows, "serve_pipeline_100k",
+                       lambda: _row_serve_pipeline(rows))
         elif "--serve" in argv:
             # serving-layer A/B only (ISSUE 3): the quick loop for
             # iterating on batcher/registry parameters

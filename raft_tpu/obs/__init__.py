@@ -44,6 +44,7 @@ metric catalogue.
 
 from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
+from . import dispatch
 from . import http
 from . import mem
 from . import metrics
@@ -64,7 +65,7 @@ from .requestlog import RequestLog
 from .slo import SLOPolicy, SLOTracker
 
 __all__ = [
-    "metrics", "compile", "http", "instrument", "attribution",
+    "metrics", "compile", "dispatch", "http", "instrument", "attribution",
     "CompileRecord", "MetricsExporter", "start_http_exporter",
     "stop_http_exporter", "Registry", "DEFAULT_BUCKETS", "RATIO_BUCKETS",
     "counter", "gauge", "histogram", "snapshot", "to_prometheus", "to_json",

@@ -1,0 +1,57 @@
+"""Per-flush device-dispatch counting for the serve hot path.
+
+The host-free flush pipeline's third claim — fewer dispatches on the mesh
+path — needs a meter: how many device interactions (jitted program calls
+and host→device transfers at the instrumented serve/stream sites) one
+flush actually performs. This module is that meter: a thread-local
+counter the serve flush opens around the searcher call
+(:func:`count`), with the stream/sharded scan, pad, gather, merge and
+staging-upload sites calling :func:`note` as they dispatch. The batcher
+publishes the total per flush as
+``raft_tpu_serve_dispatches_per_flush`` (catalogue:
+docs/observability.md), so the fused scatter-gather's dispatch reduction
+is attributable in the bench artifact instead of asserted from memory.
+
+This counts INSTRUMENTED DISPATCH SITES, not XLA ops: a single
+``ivf_pq.search`` call is one site even though it runs several programs.
+The number is a relative fusion meter — comparable across builds of the
+same serve path — not an absolute op count. Cost discipline matches
+:mod:`raft_tpu.obs.requestlog`: one thread-local ``getattr`` per site
+when no counter is open.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["count", "note"]
+
+_tls = threading.local()
+
+
+class count:
+    """Context manager opening a dispatch counter on the current thread;
+    read ``.total`` after exit. Reentrant-safe (inner scopes shadow, their
+    counts roll up into the outer scope on exit so a nested open never
+    loses dispatches)."""
+
+    total: int
+
+    def __enter__(self) -> "count":
+        self.total = 0
+        self._prev = getattr(_tls, "counter", None)
+        _tls.counter = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.counter = self._prev
+        if self._prev is not None:
+            self._prev.total += self.total
+
+
+def note(n: int = 1) -> None:
+    """Record ``n`` dispatches against the active counter (no-op without
+    one — instrumented sites pay one getattr when no flush is counting)."""
+    c = getattr(_tls, "counter", None)
+    if c is not None:
+        c.total += n

@@ -74,11 +74,20 @@ class collect:
     the batcher wraps each flush_fn call in one; :func:`add_span` and
     :func:`annotate` anywhere below (service flush, registry lease, stream
     search) accumulate into it. Reentrant-safe (inner scopes shadow) and a
-    no-op-cost check when no scope is open."""
+    no-op-cost check when no scope is open.
+
+    ``resume=`` re-opens an EXISTING collector instead of a fresh one —
+    how the pipelined batcher's completion stage (possibly on another
+    thread, never concurrently with dispatch) lands its spans on the same
+    batch's trace as the dispatch-side ones."""
+
+    def __init__(self, resume: _Collector | None = None):
+        self._resume = resume
 
     def __enter__(self) -> _Collector:
         self._prev = getattr(_tls, "collector", None)
-        _tls.collector = _Collector()
+        _tls.collector = (self._resume if self._resume is not None
+                          else _Collector())
         return _tls.collector
 
     def __exit__(self, *exc) -> None:

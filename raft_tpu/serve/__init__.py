@@ -28,20 +28,21 @@ feeds, and request-level tracing (``raft_tpu.obs.quality`` /
 docs/serving.md.
 """
 
-from . import batcher, errors, registry, retry, service
-from .batcher import MicroBatcher, bucket_for, bucket_sizes
+from . import batcher, errors, registry, retry, service, staging
+from .batcher import MicroBatcher, PendingFlush, bucket_for, bucket_sizes
 from .errors import (DeadlineExceededError, MemoryBudgetError,
                      OverloadedError, ReplicaUnavailableError, ServeError,
                      ServiceClosedError)
 from .registry import IndexRegistry, make_searcher
 from .retry import submit_with_retry
 from .service import SearchService
+from .staging import StagingBuffers, warm_staging
 
 __all__ = [
-    "batcher", "registry", "service", "errors", "retry",
-    "MicroBatcher", "bucket_sizes", "bucket_for",
+    "batcher", "registry", "service", "errors", "retry", "staging",
+    "MicroBatcher", "PendingFlush", "bucket_sizes", "bucket_for",
     "IndexRegistry", "make_searcher", "SearchService",
-    "submit_with_retry",
+    "submit_with_retry", "StagingBuffers", "warm_staging",
     "ServeError", "OverloadedError", "DeadlineExceededError",
     "ServiceClosedError", "MemoryBudgetError", "ReplicaUnavailableError",
 ]

@@ -18,15 +18,33 @@ ladder bounds the jitted-program set to ``log2(max_batch)+1`` shapes per
 stream — the set :func:`raft_tpu.serve.registry.IndexRegistry.publish`
 pre-warms so a hot-swap never cold-jits on the serving path.
 
+**Pipelined flushes** (``pipeline_depth > 0``): the flush worker no longer
+blocks on the device — a flush function may return a :class:`PendingFlush`
+(an un-materialized device result plus a ``materialize()`` hook), which the
+worker hands to a bounded in-flight completion stage and immediately drains
+the next batch. Under jax's async dispatch the H2D/compute/D2H of
+consecutive flushes overlap; a completion worker materializes results in
+FIFO order and resolves each batch's futures. Failure semantics are
+per-batch on both sides of the handoff: a flush function that raises at
+dispatch fails only its batch, and an in-flight flush whose
+``materialize()`` raises fails exactly its batch while the stage keeps
+draining. ``staging=`` (a :class:`~raft_tpu.serve.staging.StagingBuffers`)
+replaces the per-flush concat/pad allocations with reusable per-bucket
+buffers and starts the device upload at drain time (docs/serving.md
+"Pipelined flush").
+
 Determinism for tests: the wall clock is injected (``clock``) and the worker
 thread is optional (``start=False``); :meth:`pump` performs one synchronous
 drain-and-flush, so every queue policy (deadline expiry, bucket choice,
 occupancy) is assertable without sleeping. The background worker is a thin
-loop around the same drain path.
+loop around the same drain path. In pipelined mode :meth:`pump` also drains
+the completion stage (pass ``complete=False`` to hold flushes in flight and
+:meth:`complete` them explicitly — the out-of-order test hook).
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import functools
 import threading
@@ -35,12 +53,15 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
+import numpy as np
+
 from ..core import tracing
 from ..core.errors import expects
+from ..obs import dispatch as obs_dispatch
 from ..obs import metrics, requestlog
 from .errors import DeadlineExceededError, ServiceClosedError
 
-__all__ = ["MicroBatcher", "bucket_sizes", "bucket_for"]
+__all__ = ["MicroBatcher", "PendingFlush", "bucket_sizes", "bucket_for"]
 
 # occupancy = valid rows / bucket rows, in (0, 1]; the ladder resolves the
 # half-full-vs-full distinction that drives padding waste
@@ -102,6 +123,28 @@ def _error_total():
         "flushes whose flush_fn raised (all rows in the batch fail)")
 
 
+@functools.lru_cache(maxsize=None)
+def _inflight_gauge():
+    return metrics.gauge(
+        "raft_tpu_serve_inflight_flushes",
+        "flushes dispatched but not yet materialized in a serve stream's "
+        "bounded completion stage (pipelined mode; bounded by "
+        "pipeline_depth)")
+
+
+@functools.lru_cache(maxsize=None)
+def _dispatches_hist():
+    # the scatter-gather fusion meter (obs/dispatch.py): instrumented
+    # dispatch sites — program calls + host->device transfers on the
+    # serve/stream path — executed per flush
+    return metrics.histogram(
+        "raft_tpu_serve_dispatches_per_flush",
+        "instrumented device dispatches (program calls + transfers at the "
+        "serve/stream sites) per flush — relative fusion meter, not an "
+        "XLA op count",
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0))
+
+
 def _fail(future: Future, exc: Exception) -> None:
     """set_exception tolerant of a caller's concurrent ``cancel()`` — a
     cancelled future is already resolved, and failing to fail it must not
@@ -150,6 +193,42 @@ class _Drained:
     expired: list = field(default_factory=list)
 
 
+class PendingFlush:
+    """An un-materialized flush result — what a flush function returns to
+    opt into the pipelined completion stage. ``materialize()`` blocks until
+    the device work completes and returns the tuple of host result arrays
+    (leading dimension = the bucket); it also owns releasing any resource
+    the dispatch pinned (the service's flush holds its registry lease until
+    here, so an in-flight flush still finishes on the version it leased).
+    ``dispatches`` optionally carries the flush's instrumented dispatch
+    count (:mod:`raft_tpu.obs.dispatch`) for the per-flush histogram.
+
+    A flush function may return one of these in SYNC mode too (the batcher
+    materializes inline, identical semantics) — which is how the service
+    ships one flush implementation for both modes."""
+
+    __slots__ = ("materialize", "dispatches")
+
+    def __init__(self, materialize: Callable[[], Sequence],
+                 dispatches: int | None = None):
+        self.materialize = materialize
+        self.dispatches = dispatches
+
+
+@dataclass
+class _InFlight:
+    """One dispatched-but-unmaterialized flush in the completion stage."""
+
+    result: object        # PendingFlush, or an already-materialized tuple
+    batch: list
+    q_host: object        # host view of the padded queries (canary tap)
+    n_valid: int
+    bucket: int
+    now: float            # drain pickup instant (queue-wait boundary)
+    t_flush: float        # dispatch start (flush-wall start)
+    col: object           # requestlog collector to resume, or None
+
+
 class MicroBatcher:
     """Thread-safe dynamic micro-batcher for one serve stream.
 
@@ -163,6 +242,15 @@ class MicroBatcher:
     One batcher serves ONE stream (one index name at one ``k``): all
     submissions must share ``d`` and dtype, otherwise they could not share
     a program shape. The service layer keys batchers by ``(name, k)``.
+
+    ``pipeline_depth`` bounds the in-flight completion stage (0 = fully
+    synchronous, the pre-pipeline behavior): a flush function returning a
+    :class:`PendingFlush` is handed off un-materialized and the worker
+    immediately drains the next batch; a dedicated completion worker
+    (``start=True``) materializes FIFO. ``staging`` (a
+    :class:`~raft_tpu.serve.staging.StagingBuffers` matching this stream's
+    bucket ladder and row contract) replaces concat/pad assembly with
+    reusable buffers and an early device upload.
     """
 
     def __init__(self, flush_fn: Callable[[object], Sequence],
@@ -171,8 +259,10 @@ class MicroBatcher:
                  stream: str = "default", start: bool = True,
                  on_dequeue: Callable[[int], None] | None = None,
                  request_log=None, slo=None,
-                 on_result: Callable | None = None):
+                 on_result: Callable | None = None,
+                 pipeline_depth: int = 0, staging=None):
         expects(max_wait_us >= 0, "max_wait_us must be >= 0")
+        expects(pipeline_depth >= 0, "pipeline_depth must be >= 0")
         self._flush_fn = flush_fn
         # observability taps (all optional, all OFF the result path):
         # request_log records per-request span traces, slo feeds the
@@ -198,11 +288,28 @@ class MicroBatcher:
         # the service's O(1) admission counter; must only take leaf locks
         self._on_dequeue = on_dequeue
         self._closed = False
+        self.pipeline_depth = int(pipeline_depth)
+        self._staging = staging
+        # the bounded in-flight completion stage: dispatched flushes whose
+        # device results have not materialized yet (pipelined mode only)
+        self._inflight: collections.deque = collections.deque()
+        self._inflight_cond = threading.Condition()
+        # set (under _inflight_cond) when the flush worker's final drain is
+        # done — the completion worker must outlive the PRODUCER, not just
+        # the closed flag: exiting on a momentarily-empty stage while the
+        # worker still drains backlog would strand it blocked on the bound
+        self._flush_worker_done = False
         self._worker: threading.Thread | None = None
+        self._completer: threading.Thread | None = None
         if start:
             self._worker = threading.Thread(
                 target=self._run, name=f"raft-serve-{stream}", daemon=True)
             self._worker.start()
+            if self.pipeline_depth > 0:
+                self._completer = threading.Thread(
+                    target=self._run_completions,
+                    name=f"raft-serve-{stream}-complete", daemon=True)
+                self._completer.start()
 
     # -- submission ---------------------------------------------------------
     def submit(self, rows, *, deadline: float | None = None,
@@ -331,61 +438,112 @@ class MicroBatcher:
         batch = drained.batch
         if not batch:
             return 0
-        import numpy as np
-
         n_valid = drained.rows
         bucket = bucket_for(n_valid, self.max_batch)
         if metrics._enabled:
             # `now` is the drain/pickup instant: submit -> here is pure
-            # queueing; the flush_fn wall below is pure compute, so the
-            # two histograms decompose the request's latency
+            # queueing; dispatch->materialize below is the flush share, so
+            # the two histograms decompose the request's latency
             for r in batch:
                 _queue_wait_seconds().observe(now - r.enqueued,
                                               stream=self.stream)
             _occupancy().observe(n_valid / bucket, stream=self.stream)
             _flush_total().inc(1, stream=self.stream, bucket=bucket)
-        spans: dict = {}
-        notes: dict = {}
         t_flush = now  # assembly failures still get a sane flush wall
         col = None
         try:
             # assembly stays INSIDE the guard: the drained futures are
             # already pinned (set_running_or_notify_cancel), so any escape
             # here would kill the worker and strand them unresolved
-            q = (np.asarray(batch[0].rows) if len(batch) == 1
-                 else np.concatenate([np.asarray(r.rows) for r in batch]))
-            if n_valid < bucket:
-                pad = np.zeros((bucket - n_valid,) + q.shape[1:], q.dtype)
-                q = np.concatenate([q, pad])
+            staged_dispatches = 0
+            if self._staging is not None:
+                # reusable per-bucket staging: rows written in place, pad
+                # zeroed, device upload started at drain time (the H2D for
+                # this flush overlaps the previous flush's compute). The
+                # upload is a counted dispatch site, but the flush_fn's
+                # counter is not open yet — meter it here and fold it into
+                # this flush's dispatch observation below
+                with obs_dispatch.count() as sdc:
+                    q_host, q = self._staging.stage(
+                        [np.asarray(r.rows) for r in batch], n_valid,
+                        bucket)
+                staged_dispatches = sdc.total
+            else:
+                q = (np.asarray(batch[0].rows) if len(batch) == 1
+                     else np.concatenate([np.asarray(r.rows) for r in batch]))
+                if n_valid < bucket:
+                    pad = np.zeros((bucket - n_valid,) + q.shape[1:], q.dtype)
+                    q = np.concatenate([q, pad])
+                q_host = q
             with tracing.range("serve/flush/%d", bucket):
                 t_flush = self._clock()
                 # span collector: the flush fn (and anything below it —
                 # registry lease, stream search) records its stage walls
-                # against this batch's request ids
+                # against this batch's request ids; completion RESUMES it
                 collector = (requestlog.collect()
                              if self._request_log is not None
                              else contextlib.nullcontext())
                 with collector as col:
-                    out = tuple(np.asarray(a) for a in self._flush_fn(q))
-                flush_dt = self._clock() - t_flush
-                if col is not None:
-                    spans, notes = col.spans, col.notes
-                if metrics._enabled:
-                    _flush_seconds().observe(flush_dt, stream=self.stream)
+                    res = self._flush_fn(q)
         except Exception as e:
             _error_total().inc(1, stream=self.stream)
             flush_dt = self._clock() - t_flush
             for r in batch:
                 _fail(r.future, e)
-            if col is not None:
-                # salvage whatever stages completed before the raise — the
-                # error trace is the one that most needs the attribution
-                # (e.g. serve/lease recorded, serve/search missing says
-                # the search stage failed)
-                spans, notes = col.spans, col.notes
+            spans, notes = (col.spans, col.notes) if col is not None \
+                else ({}, {})
             self._observe_batch(batch, now, bucket, flush_dt, spans, notes,
                                 outcome="error")
             return n_valid
+        if metrics._enabled:
+            d = getattr(res, "dispatches", None)
+            if d is not None:
+                _dispatches_hist().observe(d + staged_dispatches,
+                                           stream=self.stream)
+        entry = _InFlight(res, batch, q_host, n_valid, bucket, now, t_flush,
+                          col)
+        if self.pipeline_depth > 0 and isinstance(res, PendingFlush):
+            # async dispatch: the device result rides to the bounded
+            # completion stage and THIS thread immediately drains the next
+            # batch — consecutive flushes overlap under jax async dispatch
+            self._hand_off(entry)
+        else:
+            self._complete_entry(entry)
+        return n_valid
+
+    # -- completion stage ----------------------------------------------------
+    def _complete_entry(self, e: _InFlight) -> None:
+        """Materialize one flush and resolve exactly its batch's futures.
+        Runs inline (sync mode / pump) or on the completion worker; a
+        materialize that raises fails ONLY this batch — per-batch failure
+        attribution survives the handoff."""
+        batch = e.batch
+        try:
+            # resume the dispatch-time span collector so completion-side
+            # spans (serve/search) land on the same batch's trace
+            collector = (requestlog.collect(resume=e.col)
+                         if e.col is not None else contextlib.nullcontext())
+            with collector:
+                res = e.result
+                if isinstance(res, PendingFlush):
+                    res = res.materialize()
+                out = tuple(np.asarray(a) for a in res)
+            flush_dt = self._clock() - e.t_flush
+            if metrics._enabled:
+                # flush share = dispatch -> materialized (includes any wait
+                # in the completion stage): queue_wait + flush still covers
+                # a request's life exactly
+                _flush_seconds().observe(flush_dt, stream=self.stream)
+        except Exception as exc:
+            _error_total().inc(1, stream=self.stream)
+            flush_dt = self._clock() - e.t_flush
+            for r in batch:
+                _fail(r.future, exc)
+            spans, notes = (e.col.spans, e.col.notes) if e.col is not None \
+                else ({}, {})
+            self._observe_batch(batch, e.now, e.bucket, flush_dt, spans,
+                                notes, outcome="error")
+            return
         off = 0
         for r in batch:
             r.future.set_result(tuple(a[off:off + r.n] for a in out))
@@ -393,15 +551,93 @@ class MicroBatcher:
         # observability taps run AFTER the futures resolve: the request
         # log / SLO loops and the canary's per-row sampling must never add
         # to any caller's observed latency
-        self._observe_batch(batch, now, bucket, flush_dt, spans, notes,
+        spans, notes = (e.col.spans, e.col.notes) if e.col is not None \
+            else ({}, {})
+        self._observe_batch(batch, e.now, e.bucket, flush_dt, spans, notes,
                             outcome="ok")
         if self._on_result is not None:
             try:
-                self._on_result(q[:n_valid],
-                                tuple(a[:n_valid] for a in out))
+                # the staging host view stays valid through completion (the
+                # buffer rotation covers the in-flight window) and the
+                # canary copies the rows it keeps
+                self._on_result(e.q_host[:e.n_valid],
+                                tuple(a[:e.n_valid] for a in out))
             except Exception:  # a canary tap must never fail the batch
                 pass
-        return n_valid
+
+    def _set_inflight_gauge(self, n: int) -> None:
+        if metrics._enabled:
+            _inflight_gauge().set(n, stream=self.stream)
+
+    def _hand_off(self, entry: _InFlight) -> None:
+        """Queue one dispatched flush for completion, enforcing the bound:
+        with a live completion worker the flush worker BLOCKS here when
+        ``pipeline_depth`` flushes are in flight (backpressure keeps the
+        device queue bounded); without one (pump-driven tests) the oldest
+        entry completes inline to preserve the bound deterministically."""
+        to_complete = []
+        with self._inflight_cond:
+            if self._completer is not None:
+                # the bound holds even while closing: the shutdown drain
+                # flushes the backlog through this same path, and an
+                # unbounded stage would outrun the staging-buffer
+                # rotation (sized depth+2). Blocking stays live — the
+                # completion worker only exits once the stage is empty,
+                # so it keeps popping while anything is in flight
+                while len(self._inflight) >= self.pipeline_depth:
+                    self._inflight_cond.wait()
+            else:
+                while len(self._inflight) >= self.pipeline_depth:
+                    to_complete.append(self._inflight.popleft())
+            self._inflight.append(entry)
+            n = len(self._inflight)
+            self._inflight_cond.notify_all()
+        self._set_inflight_gauge(n)
+        for e in to_complete:
+            self._complete_entry(e)
+
+    def complete(self, max_n: int | None = None) -> int:
+        """Materialize up to ``max_n`` in-flight flushes inline, oldest
+        first (all of them when ``None``); returns how many completed. The
+        deterministic test/drain hook for pipelined mode — with running
+        workers the completion thread does this continuously."""
+        done = 0
+        while max_n is None or done < max_n:
+            with self._inflight_cond:
+                if not self._inflight:
+                    break
+                e = self._inflight.popleft()
+                n = len(self._inflight)
+                self._inflight_cond.notify_all()
+            self._set_inflight_gauge(n)
+            self._complete_entry(e)
+            done += 1
+        return done
+
+    def inflight(self) -> int:
+        with self._inflight_cond:
+            return len(self._inflight)
+
+    def _run_completions(self) -> None:
+        while True:
+            with self._inflight_cond:
+                # exit requires closed AND the flush worker finished its
+                # final drain: a momentarily-empty stage mid-shutdown does
+                # not mean the producer is done, and leaving early would
+                # strand it blocked on the in-flight bound
+                while not self._inflight and not (self._closed
+                                                  and self._flush_worker_done):
+                    self._inflight_cond.wait()
+                if not self._inflight:
+                    return  # closed and the producer drained
+                e = self._inflight.popleft()
+                n = len(self._inflight)
+                self._inflight_cond.notify_all()
+            self._set_inflight_gauge(n)
+            try:
+                self._complete_entry(e)
+            except BaseException:  # pragma: no cover - _complete_entry
+                pass  # already guards; the completion worker must not die
 
     def _observe_batch(self, batch, now: float, bucket: int, flush_dt: float,
                        spans: dict, notes: dict, outcome: str) -> None:
@@ -423,22 +659,40 @@ class MicroBatcher:
                 self._slo.record_request(
                     wait, flush_dt if outcome == "ok" else float("inf"))
 
-    def pump(self, *, force: bool = False) -> int:
+    def pump(self, *, force: bool = False, complete: bool = True) -> int:
         """Synchronously sweep expired requests, then drain-and-flush once if
         the flush condition holds; returns rows flushed (0 when nothing
         flushed — pass ``force=True`` to flush regardless, e.g. when
         draining at shutdown). This is the deterministic test/drain entry;
-        the worker thread uses the same sweep/drain path."""
+        the worker thread uses the same sweep/drain path. In pipelined mode
+        the completion stage is drained afterwards so a pumped flush's
+        futures are resolved on return; ``complete=False`` leaves flushes
+        in flight (drive them with :meth:`complete` — the out-of-order
+        completion test hook). With a live completion worker that thread
+        owns completion and ``complete`` is ignored."""
         now = self._clock()
         with self._cond:
             expired = self._sweep_expired_locked(now)
             drained = (self._drain_locked(now)
                        if force or self._ready_locked(now) else _Drained())
             drained.expired = expired
-        return self._flush(drained, now)
+        n = self._flush(drained, now)
+        if complete and self._completer is None:
+            self.complete()
+        return n
 
     # -- worker -------------------------------------------------------------
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            # however this thread exits (clean drain or an escape), the
+            # completion worker may now stop once the stage empties
+            with self._inflight_cond:
+                self._flush_worker_done = True
+                self._inflight_cond.notify_all()
+
+    def _run_loop(self) -> None:
         while True:
             with self._cond:
                 now = self._clock()
@@ -478,6 +732,11 @@ class MicroBatcher:
                 if cleared and self._on_dequeue is not None:
                     self._on_dequeue(cleared)
             self._cond.notify_all()
+        with self._inflight_cond:
+            # wake the completion worker's idle wait (it checks _closed);
+            # a flush worker blocked on backpressure stays bounded and is
+            # released flush by flush as the completer drains the stage
+            self._inflight_cond.notify_all()
         if not drain:
             for r in pending:
                 _fail(r.future, ServiceClosedError(
@@ -485,8 +744,18 @@ class MicroBatcher:
         if self._worker is not None:
             self._worker.join(timeout_s)
             self._worker = None
+        if self._completer is not None:
+            # after the flush worker joined nothing appends; the completion
+            # worker drains the stage and exits
+            self._completer.join(timeout_s)
+            self._completer = None
         if drain:
             # whether or not a worker existed, anything still queued (e.g.
             # submitted in the join race, or no-worker mode) flushes here
             while self.pump(force=True):
                 pass
+        # in-flight flushes complete either way: their futures are already
+        # pinned running, and no future is ever left unresolved
+        self.complete()
+        if self._staging is not None:
+            self._staging.release()

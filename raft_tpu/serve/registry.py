@@ -133,7 +133,7 @@ class IndexRegistry:
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
                 warm: bool = True, warm_data=None, tuned=None,
-                res=None) -> dict:
+                res=None, warm_hook=None) -> dict:
         """Make ``(index, search_params)`` the active version of ``name``.
 
         Warms the searcher at every registry bucket shape for every ``k``
@@ -168,6 +168,15 @@ class IndexRegistry:
         :class:`~raft_tpu.serve.errors.MemoryBudgetError` BEFORE the warm
         spend and before any registry mutation — zero partial state, the
         same whole-or-nothing contract as every admission refusal.
+
+        ``warm_hook`` (``fn(searcher, ks) -> Any``, run only when
+        ``warm=True``) extends the warm ladder: it runs on the RESOLVED
+        searcher after the bucket warm and, critically, BEFORE the flip —
+        the seam a wrapper uses to compile extra serving programs (e.g.
+        the pipelined flush path's committed-placement staging
+        executables) without a cold window between the flip and its own
+        post-publish warm. Its return value lands in
+        ``report["warm_hook"]``.
         """
         from .._warmup import warm_buckets
 
@@ -243,6 +252,9 @@ class IndexRegistry:
                         dtype=searcher.query_dtype,
                         buckets=self.buckets, k=int(kk),
                         sample=warm_data)
+                if warm_hook is not None:
+                    report["warm_hook"] = warm_hook(
+                        searcher, tuple(int(kk) for kk in ks))
             to_retire: list[_Version] = []
             with self._lock:
                 old = self._active.get(name)

@@ -28,6 +28,7 @@ deadline and batching decision is then synchronous and clock-exact (the
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import inspect
 import threading
@@ -35,13 +36,17 @@ import time
 from concurrent.futures import Future
 from typing import Callable
 
+import numpy as np
+
 from ..core import tracing
 from ..core.errors import expects
+from ..obs import dispatch as obs_dispatch
 from ..obs import metrics, requestlog
-from .batcher import MicroBatcher, bucket_sizes, _deadline_total
+from .batcher import MicroBatcher, PendingFlush, bucket_sizes, _deadline_total
 from .errors import (DeadlineExceededError, OverloadedError,
                      ServiceClosedError)
 from .registry import IndexRegistry
+from .staging import StagingBuffers, warm_staging
 
 __all__ = ["SearchService"]
 
@@ -104,6 +109,20 @@ class SearchService:
     ``request_log`` (an :class:`raft_tpu.obs.requestlog.RequestLog`) mints
     a request id at admission and collects span timings through
     queue → flush → registry lease → index search → stream merge.
+
+    ``pipeline_depth`` (default 2) bounds the pipelined flush path's
+    in-flight completion stage (docs/serving.md "Pipelined flush"): the
+    flush worker dispatches the search WITHOUT materializing, hands the
+    pending result off, and drains the next batch — consecutive flushes
+    overlap under jax's async dispatch, with queries staged through
+    reusable per-bucket buffers. ``0`` restores the fully synchronous
+    flush (the A/B baseline `bench.py --serve-pipeline` measures
+    against). ``staging_device`` optionally pins the staging upload to
+    one device and enables query-buffer DONATION across flushes
+    (`donate_argnums` on the per-bucket stage programs); leave ``None``
+    for multi-device searchers — a sharded mesh's per-shard programs
+    take committed arrays on their own devices, and a query committed
+    elsewhere would conflict.
     """
 
     def __init__(self, registry: IndexRegistry | None = None, *,
@@ -112,7 +131,8 @@ class SearchService:
                  default_timeout_s: float | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  start_workers: bool = True,
-                 canary=None, slo=None, request_log=None):
+                 canary=None, slo=None, request_log=None,
+                 pipeline_depth: int = 2, staging_device=None):
         self.buckets = bucket_sizes(max_batch)
         self.registry = registry or IndexRegistry(buckets=self.buckets,
                                                   clock=clock)
@@ -134,6 +154,9 @@ class SearchService:
         self.default_timeout_s = default_timeout_s
         self._clock = clock
         self._start_workers = start_workers
+        expects(int(pipeline_depth) >= 0, "pipeline_depth must be >= 0")
+        self.pipeline_depth = int(pipeline_depth)
+        self._staging_device = staging_device
         expects(canary is None or (hasattr(canary, "offer")
                                    and hasattr(canary, "name")),
                 "canary must be an obs.quality.RecallCanary (offer()/name)")
@@ -185,10 +208,35 @@ class SearchService:
             # could otherwise interleave between them and leave the write
             # path routed to an index that lost the flip
             with self.registry.publish_lock(name):
+                # the staging leg of the warm ladder rides the registry's
+                # pre-flip warm_hook: the per-bucket stage programs (and,
+                # with a PINNED staging device, the searcher once per
+                # (bucket, k) on committed staged queries — placement is
+                # part of jax's executable key, so the registry's
+                # uncommitted-query warm alone would leave the flush
+                # path's committed-input executables cold) compile BEFORE
+                # the flip. A hot-swap under live pipelined load can
+                # therefore never serve the new version before its
+                # committed-placement executables exist — running this
+                # after publish() returned would open exactly that cold
+                # window, since serving traffic takes no publish lock.
+                staging_hook = None
+                if self.pipeline_depth > 0:
+                    def staging_hook(searcher, ks):
+                        return warm_staging(
+                            self.buckets, searcher.dim,
+                            searcher.query_dtype,
+                            device=self._staging_device,
+                            searcher=(searcher
+                                      if self._staging_device is not None
+                                      else None),
+                            ks=ks)
                 report = self.registry.publish(
                     name, index, search_params=search_params, k=k,
                     version=version, warm=warm, warm_data=warm_data,
-                    tuned=tuned, res=res)
+                    tuned=tuned, res=res, warm_hook=staging_hook)
+                if "warm_hook" in report:
+                    report["staging_warmed"] = report.pop("warm_hook")
                 with self._lock:
                     mut = getattr(index, "mutable", None)
                     if hasattr(index, "upsert") and hasattr(index, "searcher"):
@@ -206,7 +254,8 @@ class SearchService:
             return report
 
     # -- serving ------------------------------------------------------------
-    def _stream(self, name: str, k: int) -> MicroBatcher:
+    def _stream(self, name: str, k: int, dim: int | None = None,
+                qdtype: str | None = None) -> MicroBatcher:
         key = (name, int(k))
         with self._lock:
             # re-checked under the lock: a submit racing shutdown() must not
@@ -215,6 +264,13 @@ class SearchService:
                 raise ServiceClosedError("service is shut down")
             b = self._batchers.get(key)
             if b is None:
+                staging = None
+                if self.pipeline_depth > 0 and dim is not None:
+                    staging = StagingBuffers(
+                        self.buckets, dim, qdtype,
+                        depth=self.pipeline_depth,
+                        device=self._staging_device,
+                        stream=f"{name}.k{k}")
                 # the canary taps only its own name's flushes AT ITS OWN
                 # WIDTH — another stream's results (or the same name served
                 # at a different k) scored against this oracle would be a
@@ -234,29 +290,75 @@ class SearchService:
                     clock=self._clock, stream=f"{name}.k{k}",
                     start=self._start_workers, on_dequeue=self._rows.sub,
                     request_log=self._request_log, slo=self._slo,
-                    on_result=on_result)
+                    on_result=on_result,
+                    pipeline_depth=self.pipeline_depth, staging=staging)
                 self._batchers[key] = b
         return b
 
     def _make_flush(self, name: str, k: int):
-        def flush(padded_queries):
-            import jax
+        if self.pipeline_depth == 0:
+            # synchronous flush (the pre-pipeline path, and the A/B
+            # baseline): lease, search, block, return materialized arrays
+            def flush(padded_queries):
+                import jax
 
+                t0 = time.perf_counter()
+                with self.registry.lease(name) as v:
+                    # span collector no-ops unless this flush is traced;
+                    # the leased version pins which index epoch answered
+                    requestlog.add_span("serve/lease",
+                                        time.perf_counter() - t0)
+                    requestlog.annotate("version", v.version)
+                    t1 = time.perf_counter()
+                    out = v.searcher(padded_queries, k)
+                    # materialize before scattering: a future that resolves
+                    # is a result the caller can use at memcpy cost, and
+                    # the latency histograms measure real work, not async
+                    # dispatch
+                    jax.block_until_ready(out)
+                    requestlog.add_span("serve/search",
+                                        time.perf_counter() - t1)
+                return out
+
+            return flush
+
+        def flush(padded_queries):
+            # pipelined flush: dispatch WITHOUT materializing and hand the
+            # pending device result to the batcher's completion stage. The
+            # registry lease is held until materialization — an in-flight
+            # flush still finishes on the version it leased, and
+            # retire-after-drain waits for it exactly like a blocking flush
             t0 = time.perf_counter()
-            with self.registry.lease(name) as v:
-                # span collector no-ops unless this flush is traced; the
-                # leased version pins which index epoch answered
+            stack = contextlib.ExitStack()
+            v = stack.enter_context(self.registry.lease(name))
+            try:
                 requestlog.add_span("serve/lease", time.perf_counter() - t0)
                 requestlog.annotate("version", v.version)
                 t1 = time.perf_counter()
-                out = v.searcher(padded_queries, k)
-                # materialize before scattering: a future that resolves is a
-                # result the caller can use at memcpy cost, and the latency
-                # histograms measure real work, not async dispatch
-                jax.block_until_ready(out)
-                requestlog.add_span("serve/search",
+                with obs_dispatch.count() as dc:
+                    out = v.searcher(padded_queries, k)
+                requestlog.add_span("serve/dispatch",
                                     time.perf_counter() - t1)
-            return out
+            except BaseException:
+                # a dispatch that raises fails only its own batch — and
+                # must not strand the lease (the version could never
+                # retire)
+                stack.close()
+                raise
+
+            def materialize(_out=out, _t1=t1, _stack=stack):
+                try:
+                    res = tuple(np.asarray(a) for a in _out)
+                    requestlog.add_span("serve/search",
+                                        time.perf_counter() - _t1)
+                    return res
+                finally:
+                    _stack.close()
+
+            # uninstrumented searchers (plain sealed indexes) count as one
+            # dispatch site — the searcher call itself
+            return PendingFlush(materialize,
+                                dispatches=dc.total if dc.total else 1)
 
         return flush
 
@@ -277,8 +379,6 @@ class SearchService:
         resolve to host NumPy arrays — the serving contract is materialized
         results, not async device handles.
         """
-        import numpy as np
-
         if self._closed:
             raise ServiceClosedError("service is shut down")
         # lease for the validation reads: a concurrent publish may retire
@@ -313,7 +413,7 @@ class SearchService:
                     _deadline_total().inc(1, stream=f"{name}.k{k}")
                 raise DeadlineExceededError("timeout_s <= 0 at submit")
             deadline = self._clock() + timeout_s
-        b = self._stream(name, k)  # re-checks _closed under the lock
+        b = self._stream(name, k, dim, qdtype)  # re-checks _closed in-lock
         # atomic bounded reservation — the bound is a hard invariant, not a
         # hint, and it is O(1) regardless of how many streams are live;
         # the batcher's on_dequeue callback releases rows at drain
@@ -415,6 +515,16 @@ class SearchService:
 
     def queue_depth(self) -> int:
         return self._rows.value()
+
+    def staging_stats(self) -> dict:
+        """Per-stream staging-buffer counters (uploads, donation frees,
+        accounted byte levels) — the bench row's no-growth/donation proof
+        reads these; empty in sync mode (``pipeline_depth=0``)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {f"{name}.k{k}": b._staging.stats()
+                for (name, k), b in batchers.items()
+                if b._staging is not None}
 
     # -- shutdown -----------------------------------------------------------
     def shutdown(self, *, drain: bool = True, timeout_s: float = 10.0) -> None:

@@ -1,0 +1,236 @@
+"""Reusable staging buffers for the serve flush path.
+
+Before this module, every flush allocated: the batcher ``np.concatenate``d
+the drained rows into a fresh host array, padded it with another fresh
+array, and handed the result to the searcher, whose internal
+``jnp.asarray`` started the H2D transfer. Three allocations and a late
+transfer per flush — host allocator work on the hot path and no chance
+for flush N+1's transfer to begin while N computes.
+
+:class:`StagingBuffers` replaces that with the pipeline shape ROADMAP 5
+asks for:
+
+- **Per-bucket reusable host buffers.** Each bucket shape owns
+  ``pipeline_depth + 2`` preallocated host arrays used round-robin:
+  assembly writes rows in place and zeroes the pad tail (no allocations),
+  and the rotation guarantees a buffer is never rewritten before the
+  flush that staged it has completed — which is what lets the device
+  transfer (and the canary tap) read it without a defensive copy. The
+  window is depth + 2, not depth + 1: the completion worker POPS an
+  entry from the bounded stage before materializing it, so at the
+  moment the flush worker unblocks and stages the next batch, the
+  popped flush's buffer is still pending its canary tap alongside the
+  ``depth`` queued ones and the one being staged.
+- **Early upload.** :meth:`stage` starts the device transfer at drain
+  time, before the searcher is even called; under jax's async dispatch
+  the H2D for flush N+1 overlaps flush N's compute.
+- **Donation across flushes** (``device=`` pinned). Each bucket keeps a
+  persistent device slot; the upload runs through a per-bucket jitted
+  stage program with ``donate_argnums`` on the previous slot, so XLA may
+  reuse the old flush's query-buffer memory for the new upload instead of
+  growing the arena — steady-state staging bytes are CONSTANT, which the
+  obs.mem ledger entry for ``serve/staging`` proves (and ``stats()``
+  counts the actual donation frees). Donation rides the device's in-order
+  execution: the previous flush's scans were dispatched before the next
+  stage, so the reuse can never overtake a read. Without a pin the upload
+  is a plain uncommitted ``jax.device_put`` — REQUIRED for multi-device
+  searchers (a sharded mesh's per-shard programs take committed arrays on
+  their own devices, and a query committed elsewhere would conflict) —
+  and old slots free by reference drop instead of donation; bytes stay
+  flat either way.
+
+The stage programs are shape-keyed like every other serve program:
+:func:`warm_staging` (called from ``SearchService.publish`` under the
+ordinary ``warm=True``) compiles one per bucket BEFORE the flip, so
+staging adds zero cold compiles to the loaded window (asserted by the
+pipeline tests and ``bench.py --serve-pipeline``).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+import numpy as np
+
+from ..core.errors import expects
+from ..obs import dispatch as obs_dispatch
+from ..obs import mem as obs_mem
+
+__all__ = ["StagingBuffers", "warm_staging"]
+
+
+@functools.cache
+def _stage_fns():
+    import jax
+
+    # donated refill (pinned mode): the old slot is an OPERAND (the select
+    # is degenerate but keeps the donated buffer aliasable as the output —
+    # an identity body lets XLA pass the upload through and leaves the
+    # donation unused), so XLA reuses its memory for the staged output
+    import jax.numpy as jnp
+
+    donated = jax.jit(lambda old, new: jnp.where(True, new, old),
+                      donate_argnums=(0,))
+    return donated
+
+
+class StagingBuffers:
+    """Per-bucket double-buffered staging for one serve stream (see module
+    docstring). ``buckets`` is the stream's batch ladder, ``dim``/``dtype``
+    the stream row contract, ``depth`` the pipeline depth the buffer
+    rotation must cover, ``device`` the optional staging pin (enables
+    donation; must be None for multi-device searchers)."""
+
+    def __init__(self, buckets, dim: int, dtype: str, *, depth: int = 2,
+                 device=None, stream: str = "default"):
+        expects(int(dim) >= 1, "staging dim must be >= 1")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.device = device
+        self.stream = stream
+        self._n_host = max(2, int(depth) + 2)
+        self._lock = threading.Lock()
+        # host side: n_host preallocated buffers per bucket, rotated per
+        # flush — flush N's buffer is not reused until N has completed
+        # (rotation length covers the bounded in-flight window PLUS the
+        # entry the completion worker has popped but not finished, see
+        # module docstring)
+        self._host = {b: [np.zeros((b, self.dim), self.dtype)
+                          for _ in range(self._n_host)]
+                      for b in self.buckets}
+        self._turn = {b: 0 for b in self.buckets}
+        # device side: one persistent slot per bucket (pinned mode), the
+        # donation target across flushes
+        self._slots: dict[int, object] = {}
+        self._uploads = 0
+        self._donation_frees = 0
+        # ledger: staging bytes are serve-owned long-lived allocations —
+        # attributed so capacity planning sees them and the no-growth
+        # contract is provable from /debug/mem
+        host = [buf for bufs in self._host.values() for buf in bufs]
+        self._mem = obs_mem.account("serve/staging", name=stream,
+                                    host=host, owner=self)
+
+    def _reaccount(self) -> None:
+        if self._mem is None:
+            return
+        host = [buf for bufs in self._host.values() for buf in bufs]
+        with self._lock:
+            device = list(self._slots.values())
+        obs_mem.reaccount(self._mem, host=host, device=device)
+
+    def stage(self, blocks, n_valid: int, bucket: int):
+        """Assemble ``blocks`` (a list of (r, dim) host arrays totalling
+        ``n_valid`` rows) into the bucket's next staging buffer, zero the
+        pad tail, and start the device upload. Returns ``(host_view,
+        device_array)`` — the host view stays valid until this flush's
+        completion (the rotation contract), the device array is what the
+        flush function dispatches on."""
+        expects(bucket in self._host,
+                "bucket %d is not on the staging ladder %s", bucket,
+                self.buckets)
+        with self._lock:
+            turn = self._turn[bucket]
+            self._turn[bucket] = (turn + 1) % self._n_host
+        buf = self._host[bucket][turn]
+        off = 0
+        for r in blocks:
+            nr = len(r)
+            buf[off:off + nr] = r
+            off += nr
+        if n_valid < bucket:
+            buf[n_valid:] = 0
+        dev = self._upload(bucket, buf)
+        obs_dispatch.note(1)
+        return buf, dev
+
+    def _upload(self, bucket: int, buf):
+        import jax
+
+        self._uploads += 1
+        if self.device is None:
+            # uncommitted upload: composes with committed per-shard
+            # programs (jax moves it); slots still track the latest upload
+            # so accounted bytes mean the same thing in both modes
+            dev = jax.device_put(buf)
+            with self._lock:  # a concurrent stats() iterates _slots
+                grew = bucket not in self._slots
+                self._slots[bucket] = dev
+            if grew:
+                self._reaccount()
+            return dev
+        with self._lock:
+            old = self._slots.get(bucket)
+        if old is None:
+            dev = jax.device_put(buf, self.device)
+            with self._lock:
+                self._slots[bucket] = dev
+            self._reaccount()
+            return dev
+        dev = _stage_fns()(old, buf)
+        if old.is_deleted():
+            self._donation_frees += 1
+        # same bytes, new buffer — the ledger entry's totals are unchanged,
+        # so no reaccount (the no-growth contract IS the claim)
+        with self._lock:
+            self._slots[bucket] = dev
+        return dev
+
+    def stats(self) -> dict:
+        """Staging counters for the bench row / debug: uploads, actual
+        donation frees (pinned mode; 0 unpinned), and the accounted
+        byte levels that must stay flat across flushes."""
+        host = sum(buf.nbytes for bufs in self._host.values()
+                   for buf in bufs)
+        with self._lock:  # the flush worker inserts slots concurrently
+            slots = list(self._slots.values())
+        dev = sum(int(np.prod(s.shape)) * s.dtype.itemsize for s in slots)
+        return {"uploads": self._uploads,
+                "donation_frees": self._donation_frees,
+                "host_bytes": int(host), "device_bytes": int(dev),
+                "buckets_resident": len(slots),
+                "pinned": self.device is not None}
+
+    def release(self) -> None:
+        """Drop the ledger entry and slots (stream close)."""
+        if self._mem is not None:
+            obs_mem.release(self._mem)
+            self._mem = None
+        with self._lock:
+            self._slots.clear()
+
+
+def warm_staging(buckets, dim: int, dtype: str, device=None,
+                 searcher=None, ks=()) -> int:
+    """Compile the per-bucket stage programs ahead of the hot path — the
+    staging leg of the publish warm ladder. A no-op set of transfers in
+    unpinned mode (``device_put`` compiles nothing); in pinned mode one
+    tiny donated program per bucket shape compiles here so the first
+    pipelined flush finds it hot.
+
+    ``searcher``/``ks``: in PINNED mode the staged queries are COMMITTED
+    to the staging device, and placement is part of jax's executable key
+    (the sharded warm's lesson) — so the registry's uncommitted-query warm
+    does NOT cover the flush path's programs. Pass the published searcher
+    and its serving widths to run it once per (bucket, k) on staged
+    queries, compiling exactly the executables the pipelined hot path
+    dispatches. Returns the number of buckets warmed."""
+    import jax
+
+    n = 0
+    dt = np.dtype(dtype)
+    for b in sorted(set(int(b) for b in buckets)):
+        buf = np.zeros((b, int(dim)), dt)
+        if device is None:
+            staged = jax.device_put(buf)
+        else:
+            old = jax.device_put(buf, device)
+            staged = _stage_fns()(old, buf)
+        if searcher is not None:
+            for k in ks:
+                jax.block_until_ready(jax.tree_util.tree_leaves(
+                    searcher(staged, int(k)))[0])
+        n += 1
+    return n

@@ -52,6 +52,7 @@ import numpy as np
 from ..core.errors import RaftError, expects
 from ..core.resources import default_resources
 from ..distance.types import DistanceType, resolve_metric
+from ..obs import dispatch as obs_dispatch
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..serve.errors import OverloadedError
@@ -250,6 +251,7 @@ def _map_ids(ids, id_map):
 
 
 def _merge(sealed_d, sealed_i, delta_d, delta_i, k, select_min):
+    obs_dispatch.note(1)
     return _jits()[1](sealed_d, sealed_i, delta_d, delta_i, int(k),
                       bool(select_min))
 
@@ -428,6 +430,9 @@ def _scan_state(st: _StreamState, queries, k: int, res=None,
                              sample_filter=dkeep, res=res)
     di = _map_ids(di, dids)
     t2 = time.perf_counter()
+    # the dispatch meter (obs/dispatch.py): sealed search + delta scan +
+    # the two id maps = 4 instrumented sites per epoch scan
+    obs_dispatch.note(4)
     requestlog.add_span("stream/sealed", t1 - t0)
     requestlog.add_span("stream/delta", t2 - t1)
     return sd, si, dd, di
@@ -891,6 +896,7 @@ class MutableIndex:
         dd, di = brute_force.knn(delta, queries, kd, cfg.metric,
                                  cfg.metric_arg, sample_filter=dkeep, res=res)
         di = _map_ids(di, dids)
+        obs_dispatch.note(4)  # store scan + delta scan + two id maps
         return sd, si, dd, di
 
     def _store_device(self, st: _StreamState):

@@ -66,6 +66,7 @@ import numpy as np
 
 from ..core.errors import expects
 from ..core.resources import default_resources
+from ..obs import dispatch as obs_dispatch
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from . import mutable as _mut
@@ -109,6 +110,7 @@ def _shard_jits():
 
 
 def _pad_part(d, i, k: int, select_min: bool):
+    obs_dispatch.note(1)
     return _shard_jits()[0](d, i, int(k), bool(select_min))
 
 
@@ -129,7 +131,44 @@ def _view_scan(view, queries, k, res=None):
 
 
 def _merge_parts(ds, is_, k: int, select_min: bool):
+    obs_dispatch.note(1)
     return _shard_jits()[1](tuple(ds), tuple(is_), int(k), bool(select_min))
+
+
+def _resident_on(x, device) -> bool:
+    """Whether a candidate part already lives (committed) on ``device`` —
+    the skip test of the fused gather. Anything that cannot prove
+    residency moves (moving is always correct; skipping is the
+    optimization)."""
+    try:
+        devs = x.devices()
+        return len(devs) == 1 and next(iter(devs)) == device
+    except Exception:  # non-jax arrays (host numpy parts) always move
+        return False
+
+
+def _gather_parts(parts_d, parts_i, device):
+    """The one merge-device gather, shared by the serving scatter-gather
+    and the warm ladder: move candidate parts onto ``device`` for the
+    single cross-shard ``_select_k`` merge, SKIPPING parts already
+    resident there (shard 0's candidates live on the merge device — the
+    old per-call ``device_put`` of every part re-dispatched 4S transfers
+    per flush, S of them no-ops) and batching the movers into ONE
+    ``device_put`` call. Returns ``(parts_d, parts_i, moved)`` where
+    ``moved`` counts the arrays that actually crossed devices."""
+    if device is None:
+        return parts_d, parts_i, 0
+    import jax
+
+    arrays = list(parts_d) + list(parts_i)
+    move = [j for j, a in enumerate(arrays) if not _resident_on(a, device)]
+    if move:
+        placed = jax.device_put(tuple(arrays[j] for j in move), device)
+        for j, a in zip(move, placed):
+            arrays[j] = a
+        obs_dispatch.note(len(move))
+    s = len(parts_d)
+    return arrays[:s], arrays[s:], len(move)
 
 
 @functools.lru_cache(maxsize=None)
@@ -462,9 +501,11 @@ class ShardedMutableIndex:
         collect each shard's sealed + delta candidate sets, and merge all
         ``2S`` parts through ONE ``select_k`` dispatch. ``scan`` is the
         per-state scan half (serving: :func:`mutable._scan_state`; oracle:
-        the bound ``_exact_scan``)."""
-        import jax
-
+        the bound ``_exact_scan``). The gather moves ONLY the parts not
+        already resident on the merge device, in one ``device_put``
+        (:func:`_gather_parts`), and the flush's dispatch count rides the
+        obs dispatch meter + the ``stream_moved_parts`` trace note so the
+        fusion win is attributable per flush."""
         from ..obs import requestlog
 
         k = int(k)
@@ -480,15 +521,14 @@ class ShardedMutableIndex:
                 parts_d.append(d)
                 parts_i.append(i)
         t0 = time.perf_counter()
-        if self._merge_device is not None:
-            # the gather: ONLY the (m, k) candidate tuples cross devices
-            parts_d = [jax.device_put(d, self._merge_device)
-                       for d in parts_d]
-            parts_i = [jax.device_put(i, self._merge_device)
-                       for i in parts_i]
+        # the gather: ONLY the (m, k) candidate tuples cross devices, and
+        # only the non-resident ones move
+        parts_d, parts_i, moved = _gather_parts(parts_d, parts_i,
+                                                self._merge_device)
         out = _merge_parts(parts_d, parts_i, k, self._select_min)
         requestlog.add_span("stream/merge", time.perf_counter() - t0)
         requestlog.annotate("stream_shards", len(states))
+        requestlog.annotate("stream_moved_parts", moved)
         return out
 
     def search(self, queries, k: int, res=None):
@@ -606,11 +646,8 @@ class ShardedMutableIndex:
                                 jax.block_until_ready((dd, di))
                         parts_d += [sd, dd]
                         parts_i += [si, di]
-                    if self._merge_device is not None:
-                        parts_d = [jax.device_put(d, self._merge_device)
-                                   for d in parts_d]
-                        parts_i = [jax.device_put(i, self._merge_device)
-                                   for i in parts_i]
+                    parts_d, parts_i, _ = _gather_parts(
+                        parts_d, parts_i, self._merge_device)
                     jax.block_until_ready(_merge_parts(
                         parts_d, parts_i, kk, self._select_min))
                 out[kk][b] = {"wall_s": round(time.perf_counter() - t0, 3),

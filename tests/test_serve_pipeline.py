@@ -1,0 +1,525 @@
+"""Pipelined-flush tests (tier-1 ``serve`` marker, ISSUE 12).
+
+The host-free flush pipeline's contracts, all deterministic (injected
+clocks, ``start_workers=False`` + ``pump(complete=False)`` /
+``complete()`` to drive the completion stage by hand — no wall sleeps):
+
+- pipelined results are identical to the synchronous flush path;
+- OUT-OF-ORDER completion: a slow flush N finishing after N+1's device
+  work resolves only its own futures, with per-batch request-log and SLO
+  attribution intact;
+- an in-flight flush that raises AFTER the handoff fails exactly its
+  batch (and a dispatch-time raise releases the registry lease);
+- the in-flight window is bounded by ``pipeline_depth``;
+- staging buffers are ledger-accounted and FLAT across flushes, with
+  donation actually freeing the previous query buffer in pinned mode;
+- the warm ladder covers the staging programs: zero cold compiles across
+  pipelined flushes after publish;
+- the fused scatter-gather gather skips merge-device-resident parts.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import obs, stream
+from raft_tpu.neighbors import brute_force
+from raft_tpu.obs import dispatch as obs_dispatch
+from raft_tpu.obs import mem as obs_mem
+from raft_tpu.obs import requestlog
+from raft_tpu.serve import (MicroBatcher, PendingFlush, SearchService,
+                            StagingBuffers, warm_staging)
+
+pytestmark = pytest.mark.serve
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class SLORecorder:
+    """Minimal SLO stand-in: records (queue_wait, flush) samples."""
+
+    def __init__(self):
+        self.requests = []
+
+    def record_request(self, wait, flush):
+        self.requests.append((wait, flush))
+
+    def record_admission(self, ok):
+        pass
+
+
+@pytest.fixture
+def dataset(rng):
+    return rng.standard_normal((256, 16)).astype(np.float32)
+
+
+@pytest.fixture
+def bf(dataset):
+    return brute_force.BruteForce().build(dataset)
+
+
+def det_service(bf_index, clock, *, depth=2, warm=False, **kw):
+    svc = SearchService(max_batch=8, max_wait_us=1000.0, max_queue_rows=64,
+                        clock=clock, start_workers=False,
+                        pipeline_depth=depth, **kw)
+    svc.publish("main", bf_index, k=5, warm=warm)
+    return svc
+
+
+# -- parity with the synchronous path ----------------------------------------
+
+def test_pipelined_results_match_sync(bf, dataset):
+    blocks = [dataset[0:3], dataset[3:4], dataset[4:9], dataset[9:11]]
+    outs = {}
+    for depth in (0, 2):
+        clock = FakeClock()
+        svc = det_service(bf, clock, depth=depth)
+        futs = [svc.submit("main", b, 5) for b in blocks]
+        clock.advance(0.01)
+        while svc.pump(force=True):
+            pass
+        outs[depth] = [f.result(timeout=0) for f in futs]
+        svc.shutdown()
+    for (d0, i0), (d2, i2) in zip(outs[0], outs[2]):
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d2), rtol=1e-6)
+
+
+def test_pump_complete_false_defers_resolution(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, depth=2)
+    fut = svc.submit("main", dataset[:2], 5)
+    clock.advance(0.01)
+    b = svc._batchers[("main", 5)]
+    assert b.pump(complete=False) == 2
+    assert not fut.done() and b.inflight() == 1
+    assert b.complete() == 1
+    assert fut.result(timeout=0)[0].shape == (2, 5)
+    svc.shutdown()
+
+
+# -- out-of-order completion ---------------------------------------------------
+
+def _pending_flush_fn(clock, plans):
+    """A flush_fn yielding PendingFlush objects per flush, in order:
+    each plan is (materialize_delay, exception_or_None). The device
+    'result' echoes row ids so the scatter is checkable."""
+    state = {"i": 0}
+
+    def flush(q):
+        n = state["i"] = state["i"] + 1
+        delay, exc = plans[n - 1]
+        rows = np.asarray(q)
+
+        def materialize():
+            clock.advance(delay)  # a slow device-side materialization
+            if exc is not None:
+                raise exc
+            ids = np.arange(rows.shape[0])[:, None] * np.ones((1, 3))
+            return (np.full((rows.shape[0], 3), n, np.float32),
+                    ids.astype(np.int32))
+
+        return PendingFlush(materialize, dispatches=7)
+
+    return flush
+
+
+def test_slow_flush_resolves_only_its_own_futures(dataset):
+    """Flush A materializes SLOWLY after flush B was already dispatched:
+    A's completion resolves exactly A's futures with A's results, B's
+    resolve separately, and each batch keeps its own queue/flush spans in
+    the request log and its own SLO sample (per-batch attribution
+    survives the handoff)."""
+    clock = FakeClock()
+    log = requestlog.RequestLog(clock=clock)
+    slo = SLORecorder()
+    b = MicroBatcher(_pending_flush_fn(clock, [(5.0, None), (0.5, None)]),
+                     max_batch=4, max_wait_us=0.0, clock=clock, start=False,
+                     pipeline_depth=2, request_log=log, slo=slo)
+    fa = b.submit(dataset[:2], rid=log.begin("s", 2))
+    assert b.pump(complete=False) == 2          # A dispatched at t=0
+    clock.advance(1.0)
+    fb = b.submit(dataset[2:3], rid=log.begin("s", 1))
+    assert b.pump(complete=False) == 1          # B dispatched at t=1
+    assert b.inflight() == 2
+    assert not fa.done() and not fb.done()
+
+    assert b.complete(1) == 1                   # A materializes (t=1 -> 6)
+    assert fa.done() and not fb.done()
+    da, ia = fa.result(timeout=0)
+    assert da.shape == (2, 3) and float(da[0, 0]) == 1.0  # flush #1's data
+    assert b.complete(1) == 1                   # B materializes (t=6 -> 6.5)
+    db, _ = fb.result(timeout=0)
+    assert db.shape == (1, 3) and float(db[0, 0]) == 2.0  # flush #2's data
+
+    entries = {e["rid"]: e for e in log.recent()}
+    assert len(entries) == 2
+    (ra, rb) = sorted(entries)                  # req-00000001, req-00000002
+    # A: queued 0s, dispatched at 0, materialized at 6 -> flush span 6.0
+    assert entries[ra]["spans_ms"]["queue"] == pytest.approx(0.0)
+    assert entries[ra]["spans_ms"]["flush"] == pytest.approx(6000.0)
+    # B: dispatched at 1, completed at 6.5 -> flush span 5.5 (includes the
+    # documented completion-stage wait behind slow A), queue 0
+    assert entries[rb]["spans_ms"]["queue"] == pytest.approx(0.0)
+    assert entries[rb]["spans_ms"]["flush"] == pytest.approx(5500.0)
+    assert [o["outcome"] for o in entries.values()] == ["ok", "ok"]
+    # SLO saw one sample per request with the same per-batch split
+    assert sorted(f for _, f in slo.requests) == pytest.approx([5.5, 6.0])
+    b.close()
+
+
+def test_inflight_raise_after_handoff_fails_exactly_its_batch(dataset):
+    clock = FakeClock()
+    log = requestlog.RequestLog(clock=clock)
+    before = obs.to_json()
+    boom = RuntimeError("materialize exploded")
+    b = MicroBatcher(_pending_flush_fn(clock, [(0.0, boom), (0.0, None)]),
+                     max_batch=4, max_wait_us=0.0, clock=clock, start=False,
+                     pipeline_depth=2, request_log=log, stream="oops")
+    fa = b.submit(dataset[:2], rid=log.begin("oops", 2))
+    b.pump(complete=False)
+    fb = b.submit(dataset[2:3], rid=log.begin("oops", 1))
+    b.pump(complete=False)
+    assert b.complete() == 2
+    with pytest.raises(RuntimeError, match="materialize exploded"):
+        fa.result(timeout=0)
+    assert fb.result(timeout=0)[0].shape == (1, 3)  # B survived A's failure
+    d = obs.delta(before, obs.to_json())
+    assert d.get('raft_tpu_serve_flush_errors_total{stream="oops"}') == 1
+    outcomes = {e["rid"]: e["outcome"] for e in log.recent()}
+    assert sorted(outcomes.values()) == ["error", "ok"]
+    b.close()
+
+
+def test_dispatch_raise_fails_batch_and_releases_lease(bf, dataset):
+    """A flush that raises AT DISPATCH (before the handoff) fails its
+    batch and must not strand the registry lease — the raising version
+    still retires after a republish."""
+    calls = {"n": 0}
+
+    def flaky(queries, k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("dispatch exploded")
+        return bf.search(jnp.asarray(queries), k)
+
+    flaky.kind, flaky.dim, flaky.query_dtype = "custom", 16, "float32"
+    clock = FakeClock()
+    svc = SearchService(max_batch=8, clock=clock, start_workers=False,
+                        pipeline_depth=2)
+    svc.publish("main", flaky, k=5, warm=False)
+    fut = svc.submit("main", dataset[:1], 5)
+    clock.advance(0.01)
+    svc.pump()
+    with pytest.raises(ValueError, match="dispatch exploded"):
+        fut.result(timeout=0)
+    svc.publish("main", bf, k=5, warm=False)  # flips; v1 must be retirable
+    assert svc.registry.live_versions("main") == (2,)
+    fut = svc.submit("main", dataset[:1], 5)
+    clock.advance(0.01)
+    svc.pump()
+    assert fut.result(timeout=0)[0].shape == (1, 5)
+    svc.shutdown()
+
+
+# -- the bounded window --------------------------------------------------------
+
+def test_inflight_window_bounded_by_depth(dataset):
+    clock = FakeClock()
+    plans = [(0.0, None)] * 4
+    b = MicroBatcher(_pending_flush_fn(clock, plans), max_batch=4,
+                     max_wait_us=0.0, clock=clock, start=False,
+                     pipeline_depth=2)
+    futs = []
+    for j in range(3):
+        futs.append(b.submit(dataset[j:j + 1]))
+        b.pump(complete=False)
+    # the third handoff completed the OLDEST inline to hold the bound
+    assert b.inflight() == 2
+    assert futs[0].done() and not futs[2].done()
+    b.complete()
+    assert all(f.done() for f in futs)
+    b.close()
+
+
+def test_drain_shutdown_with_backlog_under_live_workers(bf, dataset):
+    """shutdown(drain=True) with a queued backlog, live workers and pinned
+    staging: the in-flight bound must hold through the close window with
+    the completion worker outliving the flush worker's final drain. (The
+    failure mode: the completer exiting on a momentarily-empty stage
+    stranded the flush worker on the bound, close()'s join timed out, and
+    its drain pump flushed CONCURRENTLY with the revived worker —
+    double-donating a staging slot, 'buffer has been deleted or donated'
+    failures.)"""
+    svc = SearchService(max_batch=8, max_wait_us=100000.0, pipeline_depth=2,
+                        staging_device=jax.devices()[0])
+    svc.publish("main", bf, k=5, warm=True)
+    # max_wait 100ms: the backlog is still queued when shutdown starts
+    futs = [svc.submit("main", dataset[j:j + 1], 5) for j in range(64)]
+    svc.shutdown(drain=True, timeout_s=30)
+    ref_i = np.asarray(bf.search(jnp.asarray(dataset[:64]), 5)[1])
+    for j, f in enumerate(futs):
+        d, i = f.result(timeout=0)  # resolved by the drain, not by us
+        np.testing.assert_array_equal(np.asarray(i)[0], ref_i[j])
+
+
+def test_close_drains_inflight(bf, dataset):
+    clock = FakeClock()
+    svc = det_service(bf, clock, depth=2)
+    fut = svc.submit("main", dataset[:2], 5)
+    clock.advance(0.01)
+    svc._batchers[("main", 5)].pump(complete=False)
+    assert not fut.done()
+    svc.shutdown(drain=True)  # close() drains the completion stage
+    assert fut.result(timeout=0)[0].shape == (2, 5)
+
+
+# -- staging ------------------------------------------------------------------
+
+def test_staging_ledger_flat_and_donation_frees():
+    dev = jax.devices()[0]
+    st = StagingBuffers((1, 2, 4), 8, "float32", depth=2, device=dev,
+                        stream="stg")
+    rows = np.ones((3, 8), np.float32)
+    levels = []
+    old_slots = []
+    for _ in range(5):
+        host, dv = st.stage([rows], 3, 4)
+        assert host.shape == (4, 8) and np.all(host[3] == 0)  # pad zeroed
+        old_slots.append(dv)
+        ent = [e for e in obs_mem.breakdown()
+               if e["component"] == "serve/staging" and e["name"] == "stg"]
+        assert len(ent) == 1
+        levels.append((ent[0]["device_bytes"], ent[0]["host_bytes"]))
+    # accounted staging bytes are FLAT across flushes — donation (or the
+    # reference drop) returns the previous buffer's bytes every cycle
+    assert len(set(levels)) == 1, levels
+    s = st.stats()
+    assert s["uploads"] == 5 and s["pinned"]
+    # pinned mode: the donated previous slot is actually freed
+    assert s["donation_frees"] >= 3, s
+    assert old_slots[0].is_deleted() and old_slots[1].is_deleted()
+    st.release()
+    assert not any(e["component"] == "serve/staging" and e["name"] == "stg"
+                   for e in obs_mem.breakdown())
+
+
+def test_staging_unpinned_composes_with_sharded_mesh(rng):
+    """Unpinned staging uploads are UNCOMMITTED, so a pipelined service can
+    front a device-pinned sharded mesh (committed per-shard arrays) without
+    a placement conflict — and results match the direct search."""
+    data = rng.standard_normal((96, 12)).astype(np.float32)
+    sm = stream.ShardedMutableIndex(
+        data, n_shards=2,
+        build=lambda x: brute_force.BruteForce().build(jnp.asarray(x)),
+        devices=jax.devices()[:2], delta_capacity=16)
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, clock=clock, start_workers=False,
+                        pipeline_depth=2)
+    svc.publish("mesh", sm, k=5, warm=False)
+    q = data[:3]
+    fut = svc.submit("mesh", q, 5)
+    clock.advance(0.01)
+    svc.pump()
+    d, i = fut.result(timeout=0)
+    dd, ii = sm.search(q, 5)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+    np.testing.assert_allclose(np.asarray(d), np.asarray(dd), rtol=1e-6)
+    svc.shutdown()
+
+
+def test_staging_buffer_rotation_covers_inflight_window(dataset):
+    """Flush N's host view must stay intact until N completes even while
+    N+1 and N+2 assemble — the depth+2 rotation contract the canary tap
+    relies on."""
+    clock = FakeClock()
+    seen = []
+
+    def flush(q):
+        rows = np.asarray(q)
+        return PendingFlush(lambda r=rows: (r.copy(), r[:, :1].copy()))
+
+    st = StagingBuffers((1, 2, 4), 16, "float32", depth=2, stream="rot")
+    b = MicroBatcher(flush, max_batch=4, max_wait_us=0.0, clock=clock,
+                     start=False, pipeline_depth=2, staging=st,
+                     on_result=lambda q, out: seen.append(np.asarray(q).copy()))
+    blocks = [dataset[j * 2:(j + 1) * 2] for j in range(3)]
+    for blk in blocks:
+        b.submit(blk)
+        b.pump(complete=False)
+    b.complete()
+    assert len(seen) == 3
+    for blk, got in zip(blocks, seen):
+        np.testing.assert_array_equal(got, blk)  # no buffer was clobbered
+    b.close()
+
+
+def test_staging_rotation_survives_live_completion_worker(dataset):
+    """The completion worker POPS an entry from the bounded stage before
+    materializing it, which unblocks the flush worker one flush early —
+    and staging happens BEFORE the handoff blocks, so the next batch is
+    written while the popped flush's host view is still pending its
+    canary tap. The depth+2 rotation must cover that window (depth+1 did
+    not: the canary saw flush N+depth+1's queries against flush N's
+    results)."""
+    import threading as _threading
+    import time as _time
+
+    gate = _threading.Event()
+    release = _threading.Event()
+    seen = []
+
+    def flush(q):
+        rows = np.asarray(q)
+
+        def materialize(r=rows):
+            gate.set()  # popped off the stage; now wedge until released
+            release.wait(10)
+            return (r.copy(), r[:, :1].copy())
+
+        return PendingFlush(materialize)
+
+    st = StagingBuffers((1, 2), 16, "float32", depth=1, stream="live")
+    # max_batch=2 and 2-row blocks: every submit is exactly one full
+    # flush, so block j always lands in staging buffer j % n_host
+    b = MicroBatcher(flush, max_batch=2, max_wait_us=0.0,
+                     clock=_time.monotonic, start=True, pipeline_depth=1,
+                     staging=st,
+                     on_result=lambda q, out: seen.append(
+                         np.asarray(q).copy()))
+    blocks = [dataset[j * 2:(j + 1) * 2] for j in range(3)]
+    futs = [b.submit(blocks[0])]
+    assert gate.wait(10)  # flush 0 popped and wedged in materialize
+    # flush 1 fills the depth-1 stage; flush 2 is STAGED before its
+    # handoff blocks — the overwrite window for flush 0's buffer
+    futs.append(b.submit(blocks[1]))
+    futs.append(b.submit(blocks[2]))
+    release.set()
+    for f in futs:
+        f.result(timeout=10)
+    b.close()
+    assert len(seen) == 3
+    for blk, got in zip(blocks, seen):
+        np.testing.assert_array_equal(got, blk)  # no buffer was clobbered
+
+
+# -- warm coverage ------------------------------------------------------------
+
+def test_pipelined_flushes_zero_cold_compiles_after_publish(bf, dataset):
+    from raft_tpu.obs import compile as obs_compile
+
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, clock=clock, start_workers=False,
+                        pipeline_depth=2, staging_device=jax.devices()[0])
+    report = svc.publish("main", bf, k=5, warm=True)
+    assert report["staging_warmed"] == 3  # buckets 1, 2, 4
+    with obs_compile.attribution() as rec:
+        for j in range(4):
+            fut = svc.submit("main", dataset[j:j + 2], 5)
+            clock.advance(0.01)
+            svc.pump()
+            assert fut.result(timeout=0)[0].shape == (2, 5)
+    assert rec.cache_misses == 0, "pipelined flush cold-compiled"
+    assert rec.compile_s == 0.0
+    svc.shutdown()
+
+
+def test_staging_warm_runs_before_the_flip(bf, dataset):
+    """A hot-swap republish must compile the pipelined flush path's
+    committed-placement executables BEFORE the flip: serving traffic
+    takes no publish lock, so warming them after publish() returns opens
+    a cold window where a flush leases the new version first. The new
+    searcher's staged warm calls must all observe the OLD version still
+    active."""
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, clock=clock, start_workers=False,
+                        pipeline_depth=2, staging_device=jax.devices()[0])
+    svc.publish("main", bf, k=5, warm=True)
+    active_at_warm = []
+
+    def hook(queries, k):
+        active_at_warm.append(svc.registry.active("main").version)
+        return bf.search(jnp.asarray(queries), k)
+
+    hook.kind, hook.dim, hook.query_dtype = "custom", 16, "float32"
+    report = svc.publish("main", hook, k=5, warm=True)
+    assert report["staging_warmed"] == 3  # buckets 1, 2, 4
+    assert active_at_warm and all(v == 1 for v in active_at_warm), \
+        active_at_warm
+    assert svc.registry.active("main").version == 2
+    svc.shutdown()
+
+
+# -- dispatch metering ---------------------------------------------------------
+
+def test_dispatches_per_flush_recorded(bf, dataset):
+    clock = FakeClock()
+    before = obs.to_json()
+    svc = det_service(bf, clock, depth=2)
+    fut = svc.submit("main", dataset[:1], 5)
+    clock.advance(0.01)
+    svc.pump()
+    fut.result(timeout=0)
+    d = obs.delta(before, obs.to_json())
+    # a plain sealed searcher counts as one dispatch site, plus the
+    # staging upload the batcher meters at drain time
+    assert d.get('raft_tpu_serve_dispatches_per_flush_count'
+                 '{stream="main.k5"}') == 1
+    assert d.get('raft_tpu_serve_dispatches_per_flush_sum'
+                 '{stream="main.k5"}') == 2
+    svc.shutdown()
+
+
+def test_fused_gather_skips_resident_parts(rng):
+    """S=2 device-pinned mesh: shard 0's candidate parts are already on
+    the merge device, so the fused gather moves exactly shard 1's 4 arrays
+    (2 parts x d+i) instead of all 8 — and the count is attributable via
+    the dispatch meter and the stream_moved_parts trace note."""
+    data = rng.standard_normal((96, 12)).astype(np.float32)
+    sm = stream.ShardedMutableIndex(
+        data, n_shards=2,
+        build=lambda x: brute_force.BruteForce().build(jnp.asarray(x)),
+        devices=jax.devices()[:2], delta_capacity=16)
+    q = data[:3]
+    sm.search(q, 5)  # warm the programs so counts are steady-state
+    with requestlog.collect() as col:
+        with obs_dispatch.count() as dc:
+            sm.search(q, 5)
+    assert col.notes["stream_moved_parts"] == 4, col.notes
+    # scans (4 sites x 2 shards) + gather moves (4) + merge (1); no pads
+    # at k=5 vs an 8-row delta bucket and 40+ sealed rows per shard
+    assert dc.total == 13, dc.total
+
+    # unpinned mesh: no merge device, nothing moves
+    sm1 = stream.ShardedMutableIndex(
+        data, n_shards=2,
+        build=lambda x: brute_force.BruteForce().build(jnp.asarray(x)),
+        delta_capacity=16)
+    sm1.search(q, 5)
+    with requestlog.collect() as col1:
+        sm1.search(q, 5)
+    assert col1.notes["stream_moved_parts"] == 0
+
+
+# -- worker-thread end to end --------------------------------------------------
+
+def test_pipelined_worker_threads_end_to_end(bf, dataset):
+    svc = SearchService(max_batch=8, max_wait_us=200.0, pipeline_depth=2)
+    svc.publish("main", bf, k=5, warm=False)
+    futs = [svc.submit("main", dataset[j:j + 1], 5) for j in range(24)]
+    ref_d, ref_i = bf.search(jnp.asarray(dataset[:24]), 5)
+    for j, f in enumerate(futs):
+        d, i = f.result(timeout=30)
+        np.testing.assert_array_equal(np.asarray(i)[0], np.asarray(ref_i)[j])
+    svc.shutdown()
