@@ -133,6 +133,22 @@ archives per round:
                                  twin (gated by bench/compare.py),
                                  recovery_s + replay_rows_per_s recorded,
                                  zero cold compiles post-warm.
+  reshard_churn_100k             elastic-resharding proof (ISSUE 13): a
+                                 loaded 2-shard x 2-replica mesh DOUBLES
+                                 its shard count online — reader threads
+                                 live through fold, carry-over and the
+                                 atomic flip with one replica killed
+                                 mid-migration — zero failed queries,
+                                 zero cold compiles (rehearsal protocol;
+                                 the successors' ladders + the doubled
+                                 merge warm pre-flip), recall_pre/post vs
+                                 the exact mesh oracle held across the
+                                 flip (compare.py-gated), plus a measured
+                                 crash-mid-reshard recovery: SimulatedCrash
+                                 between successor swap and manifest
+                                 write, load() recovers the OLD topology
+                                 id-for-id (recall_crash_recovered).
+                                 `--reshard` runs ONLY this row.
   ivf_flat_1m_p8                 IVF-Flat on the isotropic clustered 1M set
   cagra_1m_itopk32               CAGRA on the same set
 
@@ -2174,6 +2190,247 @@ def _row_crash_recovery(rows, n=100_000, d=64, n_lists=512, k=10,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _row_reshard_churn(rows, n=100_000, d=64, n_lists=512, k=10,
+                       n_probes=16, shards=2, replicas=2, steps=40,
+                       qbatch=64, reshard_at=20, write_every=4,
+                       write_rows=16, delta_capacity=4096, n_eval=256,
+                       readers=2):
+    """Elastic-resharding proof riding the default bench (ISSUE 13): a
+    loaded ``shards``×``replicas`` mesh DOUBLES its shard count online —
+    reader threads hammer the scatter-gather for the whole window, one
+    replica of shard 0 is killed the moment the migration starts (the
+    currently-preferred twin, so the next pick strikes deterministically),
+    and writes land mid-migration through the reshard/split fault seam (so
+    successor shapes stay schedule-deterministic for the rehearsal
+    protocol). Asserted:
+
+    - **zero failed queries** across fold, kill, carry-over and flip —
+      failover covers the dead twin, leases drain on the old topology;
+    - **zero cold compiles** over the measured window (rehearsal protocol:
+      the identical schedule replays warm; the successors' ladders and the
+      doubled-merge shape were compiled pre-flip);
+    - **recall anchor held**: recall@k vs the exact mesh oracle measured
+      before and after the flip (``recall_pre``/``recall_post``, both
+      gated by bench/compare.py like every recall field);
+    - **measured crash-mid-reshard recovery**: a third durable mesh takes
+      the same write burst, a SimulatedCrash fires at ``reshard/flip``
+      (between the successor swap and the manifest write), and
+      ``ShardedMutableIndex.load`` recovers the OLD topology —
+      ``crash_recovery_s`` recorded, ``recall_crash_recovered`` == 1.0
+      id-for-id vs an uncrashed twin (gated).
+    """
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.testing import faults
+
+    assert reshard_at < steps and replicas >= 2
+    _note("reshard churn: dataset")
+    rng = np.random.default_rng(17)
+    x = rng.random((n, d), np.float32)
+    pool = rng.random((1024, d), np.float32)
+    churn = rng.random(((steps + 2) * write_rows, d), np.float32)
+    eval_q = rng.random((n_eval, d), np.float32)
+    nl = max(n_lists // shards, 8)
+    sp = ivf_flat.SearchParams(n_probes=max(n_probes // shards, 1))
+
+    def build(r):
+        return ivf_flat.build(ivf_flat.IndexParams(n_lists=nl, seed=0), r)
+
+    def recall_vs_oracle(sm):
+        _, ia = sm.search(eval_q, k)
+        _, ie = sm.exact_search(eval_q, k)
+        ia, ie = np.asarray(ia), np.asarray(ie)
+        return float(np.mean([len(set(a.tolist()) & set(b.tolist())) / k
+                              for a, b in zip(ia, ie)]))
+
+    def make_mesh(name, dir_=None):
+        sm = stream.ShardedMutableIndex(
+            x, n_shards=shards, replicas=replicas, build=build,
+            search_params=sp, delta_capacity=delta_capacity,
+            wal_dir=dir_,
+            fencing=stream.FencingPolicy(max_consecutive=2, backoff_s=0.05,
+                                         backoff_max_s=0.5),
+            name=name)
+        sm.warm((qbatch, n_eval), ks=(k,))
+        jax.block_until_ready(sm.search(pool[:qbatch], k))  # sealed side
+        jax.block_until_ready(sm.search(eval_q, k))
+        jax.block_until_ready(sm.exact_search(eval_q, k))  # oracle shapes
+        return sm
+
+    def run_window(sm):
+        """The deterministic schedule: the main thread writes and
+        reshards while reader threads search continuously (fixed qbatch —
+        readers cannot perturb program shapes). The replica kill and the
+        mid-migration write ride the reshard/split fault seam, so they
+        land at the same schedule point in rehearsal and measured runs."""
+        failed = [0]
+        served = [0]
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def reader(tid):
+            j = 0
+            while not stop.is_set():
+                q = pool[((tid * 61 + j) * qbatch) % 960:
+                         ((tid * 61 + j) * qbatch) % 960 + qbatch]
+                try:
+                    _, iq = sm.search(q, k)
+                    assert np.asarray(iq).shape == (qbatch, k)
+                    with lock:
+                        served[0] += 1
+                except Exception:
+                    with lock:
+                        failed[0] += 1
+                j += 1
+
+        def on_fold(ctx):
+            if ctx.get("donors") == (0,):
+                # kill the preferred twin of shard 0 the moment its fold
+                # starts (lowest EWMA, breaker closed — what _pick returns
+                # next, making the strike deterministic)
+                grp = sm.shards[0]
+                with grp._lock:
+                    j = min((jj for jj, h in enumerate(grp._health)
+                             if h.fenced_until is None and not h.stale),
+                            key=lambda jj: grp._health[jj].ewma or 0.0)
+                sm._victim = grp._replicas[j].name
+                faults.inject(
+                    "replica/search", exc=faults.FaultError("killed"),
+                    match=lambda c, v=sm._victim: c["replica"] == v)
+            else:
+                # a write only the carry-over (and, durably, the
+                # successor WALs) can deliver
+                sm.upsert(churn[steps * write_rows:
+                                (steps + 1) * write_rows])
+
+        out = {}
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=reader, args=(t,), daemon=True)
+                   for t in range(readers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(steps):
+                if i == reshard_at:
+                    out["recall_pre"] = recall_vs_oracle(sm)
+                    donors = list(sm.shards)  # strike state dies with them
+                    faults.inject("reshard/split", callback=on_fold)
+                    rep = sm.reshard(2 * shards,
+                                     warm_buckets=(qbatch, n_eval))
+                    faults.clear("reshard/split")
+                    faults.clear("replica/search")
+                    out["reshard_s"] = rep["wall_s"]
+                    out["rows_moved"] = rep["rows_moved"]
+                    out["carried_over"] = rep["steps"][0]["carried_over"]
+                    out["strikes"] = sum(
+                        h.strikes for grp in donors
+                        for h in getattr(grp, "_health", []))
+                if i % write_every == 0:
+                    sm.upsert(churn[i * write_rows:(i + 1) * write_rows])
+            out["recall_post"] = recall_vs_oracle(sm)
+        finally:
+            faults.clear("reshard/split")
+            faults.clear("replica/search")
+            stop.set()
+            for t in threads:
+                t.join(60)
+                assert not t.is_alive(), "reader wedged"
+        out["failed"] = failed[0]
+        out["served"] = served[0]
+        out["wall_s"] = time.perf_counter() - t0
+        return out
+
+    _note("reshard churn: rehearsal")
+    rehearsal = make_mesh("reshard_rehearsal")
+    run_window(rehearsal)
+    del rehearsal
+
+    _note("reshard churn: measured window")
+    mesh = make_mesh("reshard")
+    with obs_compile.attribution() as rec:
+        out = run_window(mesh)
+    assert out["failed"] == 0, (
+        f"{out['failed']} queries failed across the reshard window — the "
+        "topology flip must never fail a query")
+    assert mesh.n_shards == 2 * shards
+    assert out["strikes"] > 0, (
+        "the killed replica was never struck — the migration window did "
+        "not exercise failover")
+    assert rec.compile_s == 0.0, (
+        f"reshard window compiled {rec.compile_s}s after rehearsal — the "
+        "flip minted a program the pre-flip warm missed")
+    assert out["recall_post"] >= out["recall_pre"] - 0.02, out
+
+    _note("reshard churn: crash-mid-reshard recovery")
+    tmp = tempfile.mkdtemp(prefix="raft_reshard_")
+    try:
+        dur = make_mesh("reshard_crash", dir_=os.path.join(tmp, "mesh"))
+        twin = make_mesh("reshard_twin")
+        for sm2 in (dur, twin):
+            for s in range(6):
+                sm2.upsert(churn[s * write_rows:(s + 1) * write_rows],
+                           ids=np.arange(n + s * write_rows,
+                                         n + (s + 1) * write_rows))
+                sm2.delete(list(range(s * 8, s * 8 + 8)))
+        with faults.scope():
+            faults.inject("reshard/flip", faults.SimulatedCrash("kill -9"))
+            try:
+                dur.reshard(2 * shards)
+                raise AssertionError("crash fault never fired")
+            except faults.SimulatedCrash:
+                pass
+        del dur  # the process is gone; the wal_dir is all that survives
+        t0 = time.perf_counter()
+        rec2 = stream.ShardedMutableIndex.load(os.path.join(tmp, "mesh"),
+                                               search_params=sp)
+        crash_recovery_s = time.perf_counter() - t0
+        assert rec2.n_shards == shards, (
+            "crash before the manifest write must recover the OLD topology")
+        _, ir = rec2.search(eval_q, k)
+        _, it = twin.search(eval_q, k)
+        ids_match = float(np.mean(np.asarray(ir) == np.asarray(it)))
+        assert ids_match == 1.0, (
+            f"recovered mesh diverges from the uncrashed twin "
+            f"(id match {ids_match:.4f}) — an acknowledged write was lost")
+        replayed = rec2.last_recovery["replayed"]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    rows.append({
+        "name": "reshard_churn_100k", "n": n,
+        "shards_from": shards, "shards_to": 2 * shards,
+        "replicas": replicas,
+        "queries": out["served"] * qbatch,
+        "failed_queries": out["failed"],
+        "strikes": out["strikes"],
+        "rows_moved": out["rows_moved"],
+        "carried_over": out["carried_over"],
+        "reshard_s": round(out["reshard_s"], 3),
+        "recall_pre": round(out["recall_pre"], 4),   # gated by compare.py
+        "recall_post": round(out["recall_post"], 4),  # gated by compare.py
+        "qps": round(out["served"] * qbatch / out["wall_s"], 1),
+        "compile_s_loaded": rec.compile_s,
+        "crash_recovery_s": round(crash_recovery_s, 3),
+        "recall_crash_recovered": ids_match,          # gated by compare.py
+        "wal_records_replayed": replayed,
+        "wall_s": round(out["wall_s"], 1),
+        "reshard_note": "shard count doubled under live read/write load "
+                        "with one replica killed mid-migration; zero "
+                        "failed queries, zero cold compiles across the "
+                        "flip; crash_recovery_s = load of a mesh killed "
+                        "between successor swap and manifest write",
+    })
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -2436,6 +2693,11 @@ def _run(rows):
                    lambda: _row_crash_recovery(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "reshard_churn_100k",
+                   lambda: _row_reshard_churn(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -2542,6 +2804,14 @@ def main(argv=None):
                        lambda: _row_fault_smoke(rows))
             _row_guard(rows, "crash_recovery_100k",
                        lambda: _row_crash_recovery(rows))
+        elif "--reshard" in argv:
+            # elastic-resharding loop only (ISSUE 13): the iteration path
+            # for split/merge, carry-over and manifest-commit parameters —
+            # the loaded topology-doubling window + the crash-mid-reshard
+            # recovery measurement
+            _setup(rows)
+            _row_guard(rows, "reshard_churn_100k",
+                       lambda: _row_reshard_churn(rows))
         elif "--tune-smoke" in argv:
             # autotune loop proof only (ISSUE 7): the quick iteration
             # path for the tune sweep engine; heavy sweeps are

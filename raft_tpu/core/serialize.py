@@ -60,7 +60,11 @@ __all__ = [
 #       sequence the snapshot covers — raft_tpu.stream.wal replays only
 #       records past it at load); ivf_flat/ivf_pq/cagra/brute_force
 #       layouts are unchanged from /9.
-SERIALIZATION_VERSION = "raft_tpu/10"
+#   raft_tpu/11: new "mesh" section — the sharded tier's topology manifest
+#       (shard count, topology epoch, per-shard snapshot/WAL names and
+#       wal_seq; raft_tpu.stream.ShardedMutableIndex save/load and the
+#       reshard commit point). Every other section is unchanged from /10.
+SERIALIZATION_VERSION = "raft_tpu/11"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
@@ -70,15 +74,17 @@ SERIALIZATION_VERSION = "raft_tpu/10"
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                            "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                           "raft_tpu/8", "raft_tpu/9"}),
+                           "raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
                          "raft_tpu/6", "raft_tpu/7", "raft_tpu/8",
-                         "raft_tpu/9"}),
+                         "raft_tpu/9", "raft_tpu/10"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                         "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                        "raft_tpu/8", "raft_tpu/9"}),
-    "stream": frozenset({"raft_tpu/8", "raft_tpu/9"}),
-    "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9"}),
+                        "raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
+    "stream": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
+    "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
+    # "mesh" is new in /11 — no older layout exists to accept
+    "mesh": frozenset(),
 }
 
 

@@ -62,6 +62,11 @@ def _fold_replica_health(code: int, body: dict, h: dict) -> tuple[int, dict]:
     is not)."""
     groups = h["shards"] if "shards" in h else [h]
     body["replicas"] = h
+    if h.get("reshard") is not None:
+        # a live topology migration folds into the verdict payload
+        # (informational — the old topology keeps serving until the flip,
+        # so a migration is not degradation)
+        body["reshard"] = h["reshard"]
     healthy_min = min((g["healthy"] for g in groups), default=1)
     fenced = sum(1 for g in groups
                  for r in g.get("replicas", []) if r["fenced"])

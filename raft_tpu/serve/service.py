@@ -181,7 +181,7 @@ class SearchService:
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
                 warm: bool = True, warm_data=None, tuned=None,
-                res=None) -> dict:
+                res=None, warm_hook=None) -> dict:
         """Publish/hot-swap through the service's registry, warming against
         the SERVICE's bucket ladder (the shapes its streams actually flush).
         Safe under load: in-flight requests finish on the old version.
@@ -201,7 +201,15 @@ class SearchService:
         gate (:meth:`IndexRegistry.publish`); over budget raises
         :class:`~raft_tpu.serve.errors.MemoryBudgetError` with zero
         partial state — the registry is untouched and the write path
-        keeps its previous routing."""
+        keeps its previous routing.
+
+        ``warm_hook`` (``fn(searcher, ks)``) forwards to the registry's
+        pre-flip seam (:meth:`IndexRegistry.publish`), composed AFTER the
+        pipelined flush path's own staging warm — the seam a topology
+        change (:meth:`raft_tpu.stream.ShardedMutableIndex.reshard`) uses
+        to commit its atomic flip with every new program already warm and
+        nothing visible to serving traffic until the registry flips. Its
+        return value lands in ``report["warm_hook"]``."""
         with tracing.range("serve/publish/%s", name):
             # hold the registry's per-name publish lock across flip AND
             # handle bookkeeping: a concurrent publish to the same name
@@ -220,7 +228,7 @@ class SearchService:
                 # committed-placement executables exist — running this
                 # after publish() returned would open exactly that cold
                 # window, since serving traffic takes no publish lock.
-                staging_hook = None
+                hooks = []
                 if self.pipeline_depth > 0:
                     def staging_hook(searcher, ks):
                         return warm_staging(
@@ -231,12 +239,23 @@ class SearchService:
                                       if self._staging_device is not None
                                       else None),
                             ks=ks)
+
+                    hooks.append(("staging_warmed", staging_hook))
+                if warm_hook is not None:
+                    # the caller's hook runs LAST — a reshard commit must
+                    # see every other pre-flip warm already done
+                    hooks.append(("warm_hook", warm_hook))
+                combined = None
+                if hooks:
+                    def combined(searcher, ks, _hooks=tuple(hooks)):
+                        return {key: fn(searcher, ks) for key, fn in _hooks}
                 report = self.registry.publish(
                     name, index, search_params=search_params, k=k,
                     version=version, warm=warm, warm_data=warm_data,
-                    tuned=tuned, res=res, warm_hook=staging_hook)
-                if "warm_hook" in report:
-                    report["staging_warmed"] = report.pop("warm_hook")
+                    tuned=tuned, res=res, warm_hook=combined)
+                parts = report.pop("warm_hook", None)
+                if parts:
+                    report.update(parts)
                 with self._lock:
                     mut = getattr(index, "mutable", None)
                     if hasattr(index, "upsert") and hasattr(index, "searcher"):

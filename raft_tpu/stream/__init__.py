@@ -41,7 +41,14 @@ fresh/sealed split of FreshDiskANN (Singh et al. 2021; PAPERS.md):
   sealed+delta candidates, and STAGGERED per-shard compaction (one shard
   folded per Compactor cycle — no global stop-the-world). Serve, canary
   and request tracing resolve it duck-typed; ``replicas=R`` makes every
-  shard a :class:`ReplicatedShard` with device anti-affinity.
+  shard a :class:`ReplicatedShard` with device anti-affinity;
+  ``wal_dir=`` arms MESH-WIDE durability (one WAL per shard group +
+  atomic per-shard snapshots + a topology manifest, recovered whole by
+  ``ShardedMutableIndex.load``); ``reshard(n)`` splits/merges the
+  topology ONLINE by power-of-two steps through the same fold-and-swap
+  machinery compaction uses — warm-before-flip, leases draining on the
+  old topology, mid-migration writes carried over at the atomic swap,
+  the manifest rename as the durable commit point.
 
 Worked example + consistency model: docs/streaming.md (durability &
 replication rules under "Durability & replication"). Metrics

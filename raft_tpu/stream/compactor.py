@@ -81,14 +81,35 @@ def _c_failures():
         "log line)")
 
 
+@functools.lru_cache(maxsize=None)
+def _c_reshard_advised():
+    return metrics.counter(
+        "raft_tpu_reshard_advised_total",
+        "reshard advisories emitted by the Compactor's per-shard row "
+        "watermarks (once per transition; auto_apply is always False — an "
+        "operator or controller calls ShardedMutableIndex.reshard)")
+
+
 @dataclass(frozen=True)
 class CompactionPolicy:
     """Watermarks that arm :meth:`Compactor.run_once` (see module doc).
-    ``None`` disables a watermark; see docs/streaming.md for tuning."""
+    ``None`` disables a watermark; see docs/streaming.md for tuning.
+
+    ``reshard_rows_per_shard`` / ``reshard_min_rows_per_shard`` are the
+    ADVISORY topology watermarks for a sharded mesh: when the mean live
+    rows per shard cross the high (low) mark, the Compactor emits a
+    once-per-transition ``reshard_advised`` event recommending a
+    power-of-two split (merge) — compaction alone cannot relieve a mesh
+    that outgrew its shard count. Advice only (``auto_apply: False``, the
+    ``retune_advised`` discipline): the fold machinery stays in
+    :meth:`raft_tpu.stream.ShardedMutableIndex.reshard`, driven by an
+    operator or a controller reading ``Compactor.last_advice``."""
 
     delta_fill: float | None = 0.75
     tombstone_ratio: float | None = 0.25
     max_age_s: float | None = None
+    reshard_rows_per_shard: int | None = None
+    reshard_min_rows_per_shard: int | None = None
 
 
 class Compactor:
@@ -160,6 +181,10 @@ class Compactor:
         self._worker: threading.Thread | None = None
         self.last_report: dict | None = None
         self.last_error: BaseException | None = None
+        # standing reshard advisory (None while neither topology watermark
+        # is tripped); the counter/WARNING emit once per transition
+        self.last_advice: dict | None = None
+        self._advice_key: tuple | None = None
 
     # -- watermarks ---------------------------------------------------------
     def due(self) -> str | None:
@@ -179,6 +204,61 @@ class Compactor:
             return "age"
         return None
 
+    def _check_reshard(self) -> dict | None:
+        """Evaluate the advisory topology watermarks (see
+        :class:`CompactionPolicy`): updates ``self.last_advice`` — a
+        STANDING advisory while a mark stays crossed, None once it clears
+        — emitting the ``reshard_advised`` counter + WARNING exactly once
+        per transition. Only meaningful for an index that can actually
+        reshard (a sharded mesh); silently None otherwise."""
+        p = self.policy
+        if (p.reshard_rows_per_shard is None
+                and p.reshard_min_rows_per_shard is None):
+            return None
+        if not hasattr(self._mutable, "reshard"):
+            return None
+        st = self._mutable.stats()
+        shards = st.get("shards")
+        if not shards:
+            return None
+        per = st["live"] / shards
+        advice = None
+        if (p.reshard_rows_per_shard is not None
+                and per >= p.reshard_rows_per_shard):
+            advice = {"action": "split", "target": 2 * shards,
+                      "watermark": "reshard_rows_per_shard",
+                      "threshold": p.reshard_rows_per_shard}
+        elif (p.reshard_min_rows_per_shard is not None and shards > 1
+                and shards % 2 == 0  # reshard() only halves even counts —
+                # advising an unreachable target would send a controller
+                # into a refusal loop
+                and per <= p.reshard_min_rows_per_shard):
+            advice = {"action": "merge", "target": shards // 2,
+                      "watermark": "reshard_min_rows_per_shard",
+                      "threshold": p.reshard_min_rows_per_shard}
+        key = ((advice["action"], advice["target"])
+               if advice is not None else None)
+        if key == self._advice_key:
+            return self.last_advice
+        self._advice_key = key
+        if advice is None:
+            self.last_advice = None
+            return None
+        from ..core.logger import logger
+
+        self.last_advice = dict(
+            advice, name=self._mutable.name, shards=shards,
+            rows_per_shard=round(per, 1), auto_apply=False)
+        if metrics._enabled:
+            _c_reshard_advised().inc(1, name=self._mutable.name,
+                                     action=advice["action"])
+        logger.warning(
+            "reshard advised for %r: %s to %d shards (%.0f live rows/shard "
+            "crossed %s=%d); advisory only — call reshard(%d) to apply",
+            self._mutable.name, advice["action"], advice["target"], per,
+            advice["watermark"], advice["threshold"], advice["target"])
+        return self.last_advice
+
     # -- one compaction cycle ----------------------------------------------
     def run_once(self, *, force: bool = False, mode: str | None = None,
                  res=None) -> dict | None:
@@ -188,6 +268,10 @@ class Compactor:
         ``force=True`` compacts regardless; ``mode`` overrides the
         trigger's fold mode."""
         trigger = self.due()
+        # topology advisory rides every poll, due or not: a mesh that
+        # outgrew its shard count keeps folding without relief — the
+        # advice must not wait for a compaction watermark to also trip
+        advice = self._check_reshard()
         if trigger is None:
             if not force:
                 return None
@@ -215,6 +299,8 @@ class Compactor:
         wall = time.perf_counter() - t0
         report["wall_s"] = round(wall, 3)
         report["compile_s"] = round(rec.compile_s, 3)
+        if advice is not None:
+            report["reshard_advised"] = advice
         if self._drift is not None:
             # compaction-time corpus stats: the retained store is the live
             # corpus' raw rows (the classifier subsamples internally; a few

@@ -1200,6 +1200,7 @@ def save(mutable: MutableIndex, path: str) -> None:
 def load(path: str, *, search_params=None, index_params=None,
          builder: Callable | None = None, name: str | None = None,
          device=None, wal=None, snapshot_path: str | None = None,
+         shard: int | None = None,
          clock: Callable[[], float] = time.monotonic) -> MutableIndex:
     """Load a :func:`save`d mutable index. ``search_params``/
     ``index_params``/``builder``/``device`` are runtime configuration (like
@@ -1245,7 +1246,7 @@ def load(path: str, *, search_params=None, index_params=None,
     m = MutableIndex(sealed, search_params=search_params,
                      index_params=index_params, delta_capacity=capacity,
                      retain_vectors=has_store, dataset=store, builder=builder,
-                     device=device, snapshot_path=snapshot_path,
+                     device=device, snapshot_path=snapshot_path, shard=shard,
                      name=saved_name if name is None else name, clock=clock)
     with m._lock:
         st = m._state

@@ -52,12 +52,41 @@ snapshots each shard's state independently, so a multi-row write that
 spans shards may be half-visible to one racing read — the same anomaly
 class as any read racing a write, documented in docs/streaming.md
 ("Sharded lifecycle").
+
+Two late additions complete the availability axis (docs/streaming.md
+"Elastic resharding" / "Durability & replication"):
+
+- **Elastic resharding** (:meth:`ShardedMutableIndex.reshard`): online
+  power-of-two split/merge. Because :func:`shard_of` routes by ``h % S``,
+  doubling to ``2S`` sends every id homed on shard ``s`` to exactly ``s``
+  or ``s + S`` — a split is a LOCAL fold of one donor shard into two
+  successors (a merge the inverse), replayed shard-at-a-time through the
+  same fold machinery compaction uses: donors keep serving (and accepting
+  writes) while successors build off-lock, the new topology's whole
+  program set warms BEFORE the flip (through the registry's pre-flip
+  ``publish(warm_hook=)`` seam when a publisher drives it), writes that
+  landed mid-migration carry over at the atomic id→shard-map swap exactly
+  like compaction's mid-fold writes, and in-flight flushes finish on the
+  topology they leased (retire-after-drain generalizes to whole donor
+  shards).
+- **Mesh-wide durability** (``wal_dir=``): one
+  :class:`~raft_tpu.stream.wal.WriteAheadLog` per shard group, a
+  per-shard atomic snapshot, and a topology MANIFEST (shard count,
+  topology epoch, per-shard wal_seq) written through
+  ``core.serialize.atomic_write`` — the manifest's rename is the durable
+  commit point of both :meth:`ShardedMutableIndex.save` and a reshard, so
+  recovery (:meth:`ShardedMutableIndex.load`) replays each shard's log
+  against whichever topology the manifest committed. A crash between a
+  successor swap and the manifest write recovers to the OLD topology with
+  zero acknowledged-write loss (fault points ``reshard/split``,
+  ``reshard/flip``, ``reshard/manifest``).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import threading
 import time
 from typing import Callable, Sequence
@@ -69,11 +98,15 @@ from ..core.resources import default_resources
 from ..obs import dispatch as obs_dispatch
 from ..obs import mem as obs_mem
 from ..obs import metrics
+from ..testing import faults
 from . import mutable as _mut
 from .mutable import DeltaFullError, MutableIndex
 from .replicated import FencingPolicy, ReplicatedShard, _PinnedGroup
 
 __all__ = ["ShardedMutableIndex", "shard_of"]
+
+# the topology manifest's file name inside a mesh's wal_dir/save dir
+_MANIFEST = "manifest"
 
 
 # -- the one-dispatch merge --------------------------------------------------
@@ -179,6 +212,32 @@ def _g_shards():
         "under name/shard<i>)")
 
 
+@functools.lru_cache(maxsize=None)
+def _c_migrations():
+    return metrics.counter(
+        "raft_tpu_reshard_migrations_total",
+        "reshard migrations by action (split/merge) and phase "
+        "(started/completed) — started without completed is an aborted "
+        "or crashed migration, which recovery resolves to the old "
+        "topology")
+
+
+@functools.lru_cache(maxsize=None)
+def _c_rows_moved():
+    return metrics.counter(
+        "raft_tpu_reshard_rows_moved_total",
+        "live rows folded from donor shards into reshard successors",
+        unit="rows")
+
+
+@functools.lru_cache(maxsize=None)
+def _h_reshard():
+    return metrics.histogram(
+        "raft_tpu_reshard_seconds",
+        "one reshard step's wall seconds (fold + warm + carry-over + "
+        "flip + manifest, off the serving hot path)", unit="seconds")
+
+
 def shard_of(ids, n_shards: int):
     """Stable home shard of each global id: a SplitMix64-style avalanche
     mix mod the shard count — independent of insertion order or shard
@@ -213,9 +272,21 @@ class ShardedMutableIndex:
     ``search_params`` / ``index_params`` / ``builder`` / ``delta_capacity``
     (per shard) / ``retain_vectors`` / ``clock`` forward to every shard's
     :class:`MutableIndex`. The retained row store defaults ON (the
-    constructor holds each shard's rows anyway), so rebuild compaction and
-    :meth:`exact_search` work out of the box; pass
+    constructor holds each shard's rows anyway), so rebuild compaction,
+    :meth:`exact_search` AND :meth:`reshard` work out of the box; pass
     ``retain_vectors=False`` to drop it.
+
+    ``wal_dir`` arms mesh-wide durability: one write-ahead log per shard
+    group (``<wal_dir>/shard<i>.e<epoch>.wal``, logging every acknowledged
+    write at admission), per-shard atomic snapshots, and the topology
+    manifest — written at construction, so the mesh is recoverable
+    (:meth:`load`) from its very first acknowledged write. Per-shard WAL
+    truncation saw-tooths with each shard's compaction fold (the shards'
+    ``snapshot_path`` is armed automatically), and a :meth:`reshard`
+    commits durably through the manifest. The directory must be fresh or
+    belong to this mesh's previous life recovered via :meth:`load` — a
+    directory holding unrecovered records is refused (shadowing them
+    would lose acknowledged writes).
     """
 
     def __init__(self, dataset, *, n_shards: int, build: Callable,
@@ -226,6 +297,7 @@ class ShardedMutableIndex:
                  devices: Sequence | None = None, comms=None,
                  replicas: int = 1,
                  fencing: FencingPolicy | None = None,
+                 wal_dir: str | None = None,
                  name: str = "default",
                  clock: Callable[[], float] = time.monotonic):
         dataset = np.asarray(dataset)
@@ -262,44 +334,115 @@ class ShardedMutableIndex:
                     "replica anti-affinity needs >= %d devices so twins "
                     "of one shard land on different devices, got %d",
                     R, len(devices))
+        # the shard build recipe, retained whole: reshard successors are
+        # built with EXACTLY what the originals were
+        self._build_fn = build
+        self._search_params = search_params
+        self._index_params = index_params
+        self._builder = builder
+        self._delta_capacity = int(delta_capacity)
+        self._retain_vectors = retain_vectors
+        self._devices = devices
+        self._replicas_n = R
+        self._fencing = fencing
+        self._topology_epoch = 0
+        self._migration: dict | None = None
+        self._wal_dir = os.fspath(wal_dir) if wal_dir is not None else None
+        if self._wal_dir is not None:
+            os.makedirs(self._wal_dir, exist_ok=True)
+            # a directory with a committed manifest belongs to an earlier
+            # life of a mesh — possibly at a DIFFERENT topology epoch, so
+            # the per-shard WAL probe below would miss its files entirely
+            # and the construction-time save() would orphan every
+            # acknowledged write behind a fresh epoch-0 manifest
+            expects(not os.path.exists(
+                os.path.join(self._wal_dir, _MANIFEST)),
+                "wal_dir %r already holds a mesh manifest — recover that "
+                "mesh with ShardedMutableIndex.load() (a fresh mesh here "
+                "would shadow its acknowledged writes) or point at a "
+                "fresh directory", self._wal_dir)
         self._shards: list = []
         for s in range(n_shards):
             rows_idx = np.nonzero(owner == s)[0]
             expects(len(rows_idx) > 0,
                     "shard %d of %d owns no rows (n=%d) — use fewer shards",
                     s, n_shards, n)
-            rows_s = dataset[rows_idx]
-            sealed = build(rows_s)
-            if R == 1:
-                self._shards.append(MutableIndex(
-                    sealed, search_params=search_params,
-                    index_params=index_params,
-                    delta_capacity=delta_capacity,
-                    # the constructor holds the shard's raw rows either
-                    # way, so retention costs no extra recover pass; False
-                    # opts out
-                    retain_vectors=retain_vectors,
-                    dataset=None if retain_vectors is False else rows_s,
-                    builder=builder, ids=gids[rows_idx],
-                    device=devices[s] if devices is not None else None,
-                    name=f"{name}/shard{s}", shard=s, clock=clock))
-            else:
-                # replica j of shard s lands on devices[s*R + j] (mod the
-                # mesh): twins of one shard live on DIFFERENT devices —
-                # the anti-affinity that makes a group survive a device
-                self._shards.append(ReplicatedShard(
-                    sealed, n_replicas=R,
-                    devices=([devices[(s * R + j) % len(devices)]
-                              for j in range(R)]
-                             if devices is not None else None),
-                    search_params=search_params,
-                    index_params=index_params,
-                    delta_capacity=delta_capacity,
-                    retain_vectors=retain_vectors,
-                    dataset=None if retain_vectors is False else rows_s,
-                    builder=builder, ids=gids[rows_idx],
-                    policy=fencing or FencingPolicy(),
-                    name=f"{name}/shard{s}", shard=s, clock=clock))
+            wal_path = snap_path = None
+            if self._wal_dir is not None:
+                snap_path, wal_path = self._shard_files(s)
+            self._shards.append(self._make_shard(
+                dataset[rows_idx], gids[rows_idx], s, n_shards,
+                wal=wal_path, snapshot_path=snap_path))
+        self._next_id = int(gids.max()) + 1 if n else 0
+        self._finish_init()
+        if self._wal_dir is not None:
+            # durable by construction: the baseline snapshots + manifest
+            # land before the first write can be acknowledged, so load()
+            # works from the very first WAL record
+            self.save()
+
+    @staticmethod
+    def _shard_names(s: int, e: int) -> tuple:
+        """(snapshot, wal) FILE NAMES of shard ``s`` at topology epoch
+        ``e`` — the one place the naming scheme lives: construction,
+        save(), the manifest and the reshard commit all derive from here,
+        so the manifest can never desynchronize from the files on disk."""
+        return f"shard{s}.e{e}.idx", f"shard{s}.e{e}.wal"
+
+    def _shard_files(self, s: int, epoch: int | None = None,
+                     dir: str | None = None) -> tuple:
+        """(snapshot, wal) paths of shard ``s`` at a topology epoch —
+        epoch-keyed so a mid-reshard crash can never confuse the old
+        topology's files with a half-written successor set."""
+        e = self._topology_epoch if epoch is None else int(epoch)
+        sn, wn = self._shard_names(s, e)
+        d = self._wal_dir if dir is None else dir
+        return os.path.join(d, sn), os.path.join(d, wn)
+
+    def _make_shard(self, rows_s, gids_s, s: int, total: int, *,
+                    wal=None, snapshot_path=None):
+        """Build one home shard at ordinal ``s`` of a ``total``-shard
+        topology — the ONE recipe shared by construction and resharding.
+        Past the construction-time device floor, ordinals pin modulo the
+        device list (a split beyond the mesh size co-locates successors,
+        trading isolation for capacity — documented in streaming.md)."""
+        sealed = self._build_fn(rows_s)
+        devices = self._devices
+        if self._replicas_n == 1:
+            return MutableIndex(
+                sealed, search_params=self._search_params,
+                index_params=self._index_params,
+                delta_capacity=self._delta_capacity,
+                # the constructor holds the shard's raw rows either way,
+                # so retention costs no extra recover pass; False opts out
+                retain_vectors=self._retain_vectors,
+                dataset=(None if self._retain_vectors is False else rows_s),
+                builder=self._builder, ids=gids_s,
+                device=(devices[s % len(devices)] if devices is not None
+                        else None),
+                wal=wal, snapshot_path=snapshot_path,
+                name=f"{self._name}/shard{s}", shard=s, clock=self._clock)
+        # replica j of shard s lands on devices[s*R + j] (mod the mesh):
+        # twins of one shard live on DIFFERENT devices — the anti-affinity
+        # that makes a group survive a device
+        R = self._replicas_n
+        return ReplicatedShard(
+            sealed, n_replicas=R,
+            devices=([devices[(s * R + j) % len(devices)]
+                      for j in range(R)] if devices is not None else None),
+            search_params=self._search_params,
+            index_params=self._index_params,
+            delta_capacity=self._delta_capacity,
+            retain_vectors=self._retain_vectors,
+            dataset=(None if self._retain_vectors is False else rows_s),
+            builder=self._builder, ids=gids_s,
+            policy=self._fencing or FencingPolicy(),
+            wal=wal, snapshot_path=snapshot_path,
+            name=f"{self._name}/shard{s}", shard=s, clock=self._clock)
+
+    def _finish_init(self) -> None:
+        """Shared tail of ``__init__`` and :meth:`load`: cross-shard
+        config consistency, merge-device pin, gauge baseline."""
         cfg0 = self._shards[0]._cfg
         for s, sh in enumerate(self._shards[1:], 1):
             expects(sh._cfg.kind == cfg0.kind and sh._cfg.dim == cfg0.dim
@@ -309,8 +452,8 @@ class ShardedMutableIndex:
                     s, sh._cfg.kind, sh._cfg.dim, sh._cfg.query_dtype,
                     cfg0.kind, cfg0.dim, cfg0.query_dtype)
         self._select_min = cfg0.select_min
-        self._merge_device = devices[0] if devices is not None else None
-        self._next_id = int(gids.max()) + 1 if n else 0
+        self._merge_device = (self._devices[0]
+                              if self._devices is not None else None)
         self._update_gauges()
 
     # -- introspection ------------------------------------------------------
@@ -386,8 +529,14 @@ class ShardedMutableIndex:
         shards = [sh.health() if isinstance(sh, ReplicatedShard)
                   else {"name": sh.name, "replicas": [], "healthy": 1}
                   for sh in self._shards]
+        with self._lock:
+            migration = (dict(self._migration)
+                         if self._migration is not None else None)
         return {"name": self._name, "shards": shards,
-                "healthy_min": min(s["healthy"] for s in shards)}
+                "healthy_min": min(s["healthy"] for s in shards),
+                # live topology-migration state (None outside a reshard):
+                # folds into /healthz via obs.start_http_exporter(replicas=)
+                "reshard": migration}
 
     def _update_gauges(self, st: dict | None = None) -> None:
         if not metrics._enabled:
@@ -570,11 +719,22 @@ class ShardedMutableIndex:
         ``batched_searcher`` contract). A staggered compaction freezes only
         the folded shard's epoch inside an already-issued hook; republish
         (what the Compactor does per fold) picks up the successor — the
-        same lease-drain semantics as the single-device flow, per shard."""
+        same lease-drain semantics as the single-device flow, per shard.
+        A reshard generalizes this to whole shards: hooks issued on the
+        old topology keep serving the donor shards' frozen views until
+        their leases drain."""
+        return self._searcher_for(tuple(self._shards))
+
+    def _searcher_for(self, shards):
+        """The serving hook over an explicit shard list — what
+        :meth:`reshard` publishes for the successor topology BEFORE the
+        flip, so the registry's bucket warm compiles the new program set
+        while the old topology still serves."""
         from ..neighbors._hooks import make_hook
 
-        states = self._views()
-        cfg0 = self._shards[0]._cfg
+        states = tuple(sh.pin_group() if isinstance(sh, ReplicatedShard)
+                       else sh._state for sh in shards)
+        cfg0 = shards[0]._cfg
         fn = make_hook(
             lambda queries, k: self._scatter_gather(
                 states, queries, k, _view_scan),
@@ -593,6 +753,13 @@ class ShardedMutableIndex:
         ``(m, 2S·k)`` shape. Sealed-side programs are warmed per epoch by
         ``registry.publish`` (which runs the full hook), exactly like the
         single-device flow. Returns per-(k, bucket) compile attribution."""
+        return self._warm_impl(tuple(self._shards), buckets, ks=ks,
+                               sample=sample)
+
+    def _warm_impl(self, shards, buckets, ks=(10,), sample=None) -> dict:
+        """:meth:`warm` over an explicit shard list — :meth:`reshard`
+        warms its successors' ladder (and the successor-count merge)
+        through this BEFORE the topology flip."""
         import jax
 
         from .._warmup import _random_queries
@@ -601,7 +768,6 @@ class ShardedMutableIndex:
 
         out: dict = {}
         key = jax.random.key(0)
-        S = len(self._shards)
         for kk in sorted(set(int(x) for x in ks)):
             out[kk] = {}
             for b in sorted(set(int(x) for x in buckets)):
@@ -611,7 +777,7 @@ class ShardedMutableIndex:
                 t0 = time.perf_counter()
                 with obs_compile.attribution() as rec:
                     parts_d, parts_i = [], []
-                    for sh in self._shards:
+                    for sh in shards:
                         # a replica group warms EVERY twin's ladder on its
                         # own pinned device (placement is part of the
                         # program key): failover must never cold-compile —
@@ -697,3 +863,515 @@ class ShardedMutableIndex:
             report["epoch"] = agg["epoch"]  # aggregate fold count
             self._update_gauges(agg)
             return report
+
+    # -- elastic resharding --------------------------------------------------
+    def reshard(self, n_shards: int, *, publisher=None,
+                name: str | None = None, ks=(10,), warm_buckets=None,
+                warm_data=None, res=None) -> dict:
+        """Online power-of-two split/merge to ``n_shards`` — the topology
+        change as a sequence of LOCAL folds, never a stop-the-world.
+
+        Because :func:`shard_of` routes by ``h % S``, doubling sends every
+        id homed on shard ``s`` to exactly ``s`` or ``s + S``: each
+        doubling (halving) step folds one donor shard (donor pair) at a
+        time into its successor(s) — donors keep serving reads AND
+        accepting writes throughout — then warms the new topology's whole
+        program set, applies the writes that landed mid-migration
+        (carry-over, exactly like compaction's mid-fold writes) and flips
+        the id→shard map atomically under the write lock. A larger jump
+        (e.g. 2 → 8) runs as successive doublings, each individually
+        committed.
+
+        ``publisher`` (+ ``name``/``ks``/``warm_data``) threads the flip
+        through the registry's pre-flip ``publish(warm_hook=)`` seam: the
+        registry warms the successor searcher at every bucket, the commit
+        runs as the LAST pre-flip hook, and only then does the registry
+        pointer move — serving traffic never sees a cold program or a
+        half-migrated mesh, and in-flight flushes finish on the topology
+        they leased (publish with the same ``ks`` the name already
+        serves). Without a publisher, ``warm_buckets`` drives the
+        library-mode warm (successor delta ladders + sealed scans + the
+        new merge) before the flip.
+
+        With ``wal_dir`` durability armed, each successor gets an atomic
+        baseline snapshot + fresh WAL BEFORE the flip, carry-over writes
+        land in the successor logs, and the topology manifest's atomic
+        rename is the durable commit point: a crash at any fault point
+        (``reshard/split``/``reshard/flip``/``reshard/manifest``)
+        recovers via :meth:`load` to the OLD topology with zero
+        acknowledged-write loss — no write resurrected either, since
+        uncommitted successor files are ignored and removed.
+
+        Returns ``{from, to, steps, rows_moved, epoch, wall_s}``. Raises
+        (mesh untouched, donors still serving) on a non-power-of-two
+        ratio, a successor that would own zero rows, or a shard without
+        its retained row store."""
+        target = int(n_shards)
+        S = len(self._shards)
+        expects(target >= 1, "n_shards must be >= 1, got %d", target)
+        expects(target != S, "mesh is already at %d shards", S)
+        big, small = max(target, S), min(target, S)
+        ratio = big // small
+        expects(big % small == 0 and (ratio & (ratio - 1)) == 0,
+                "reshard moves between power-of-two-related shard counts "
+                "(%d -> %d is not): shard_of routes by h %% S, so only a "
+                "doubling/halving keeps every id's migration local to one "
+                "donor group", S, target)
+        expects(self._build_fn is not None,
+                "reshard needs the shard build recipe — construct with "
+                "build=, or pass build= to load()")
+        expects(publisher is None or hasattr(publisher, "publish"),
+                "publisher must expose publish() (SearchService or "
+                "IndexRegistry)")
+        expects(publisher is None or name is not None,
+                "a publisher needs the published name")
+        kks = (ks,) if isinstance(ks, int) else tuple(int(x) for x in ks)
+        t0 = time.perf_counter()
+        steps = []
+        while len(self._shards) != target:
+            nxt = (len(self._shards) * 2 if target > len(self._shards)
+                   else len(self._shards) // 2)
+            steps.append(self._reshard_step(
+                nxt, publisher=publisher, name=name, ks=kks,
+                warm_buckets=warm_buckets, warm_data=warm_data, res=res))
+        return {"from": S, "to": target, "steps": steps,
+                "rows_moved": sum(st["rows_moved"] for st in steps),
+                "epoch": self._topology_epoch,
+                "wall_s": round(time.perf_counter() - t0, 3)}
+
+    def _reshard_step(self, target: int, *, publisher, name, ks,
+                      warm_buckets, warm_data, res) -> dict:
+        """One doubling/halving: fold donors shard-at-a-time, warm, then
+        commit (carry-over + flip + manifest). Holds the compaction lock
+        for the whole step — a staggered fold and a migration must not
+        interleave (both rebuild shard state); writes and reads are only
+        ever blocked for the brief snapshot/commit critical sections."""
+        with self._compact_lock:
+            S = len(self._shards)
+            action = "split" if target > S else "merge"
+            if metrics._enabled:
+                _c_migrations().inc(1, name=self._name, action=action,
+                                    phase="started")
+            t0 = time.perf_counter()
+            with self._lock:
+                self._migration = {"action": action, "from": S,
+                                   "to": target, "folded_donors": 0,
+                                   "rows_moved": 0}
+            try:
+                # split: donor s feeds successors (s, s+S); merge: donors
+                # (t, t+T) feed successor t — h % S and h % target agree
+                # exactly on these groups (the power-of-two locality rule)
+                donor_groups = ([((s,), (s, s + S)) for s in range(S)]
+                                if action == "split"
+                                else [((t, t + target), (t,))
+                                      for t in range(target)])
+                successors: list = [None] * target
+                snaps: list = []
+                rows_moved = 0
+                for donors_idx, succ_idx in donor_groups:
+                    faults.fire("reshard/split", name=self._name,
+                                donors=donors_idx, action=action)
+                    rows_parts, gid_parts = [], []
+                    for di in donors_idx:
+                        donor = self._shards[di]
+                        prim = (donor._primary()
+                                if isinstance(donor, ReplicatedShard)
+                                else donor)
+                        with self._lock:
+                            # brief freeze: snapshot the donor's live rows
+                            # (sealed survivors + live delta prefix) — the
+                            # fold input; everything after this point
+                            # carries over at the commit
+                            st = prim._state
+                            expects(st.store is not None,
+                                    "reshard folds raw rows into successor "
+                                    "builds — shard %d has no retained row "
+                                    "store (retain_vectors=False)", di)
+                            snap_n = int(st.delta_n)
+                            s_live = np.nonzero(st.sealed_alive)[0]
+                            d_live = np.nonzero(
+                                st.delta_alive[:snap_n])[0]
+                            rows = np.concatenate(
+                                [st.store[s_live], st.delta[d_live]])
+                            gids = np.concatenate(
+                                [st.id_map[s_live],
+                                 st.delta_ids[d_live].astype(np.int64)])
+                            # tombstone watermarks at the snapshot: a
+                            # delete (or replacing upsert) of a snapshot-
+                            # live id must flip one of these, so the
+                            # commit can SKIP its dead-id scan whenever
+                            # they are unchanged — the common case
+                            dead0 = (int(st.sealed_dead_n),
+                                     snap_n - len(d_live))
+                        rows_parts.append(rows)
+                        gid_parts.append(gids)
+                        # the DONOR rides to the commit (not the twin the
+                        # fold read): a replicated donor's primary can go
+                        # stale mid-migration, and the commit must read
+                        # carry-over state from a twin that received
+                        # every acknowledged write
+                        snaps.append((donor, snap_n, gids, dead0))
+                    rows = (np.concatenate(rows_parts)
+                            if len(rows_parts) > 1 else rows_parts[0])
+                    gids = (np.concatenate(gid_parts)
+                            if len(gid_parts) > 1 else gid_parts[0])
+                    owner = shard_of(gids, target)
+                    for t in succ_idx:
+                        mask = owner == t
+                        expects(int(mask.sum()) > 0,
+                                "successor shard %d of %d would own no "
+                                "live rows — the corpus is too small for "
+                                "this split", t, target)
+                        # the heavy build runs OFF every lock: donors keep
+                        # serving and accepting writes
+                        successors[t] = self._make_shard(
+                            rows[mask], gids[mask], t, target)
+                    rows_moved += int(len(gids))
+                    with self._lock:
+                        self._migration["folded_donors"] += len(donors_idx)
+                        self._migration["rows_moved"] = rows_moved
+                succ = tuple(successors)
+                # warm BEFORE any flip: successor delta ladders + pads +
+                # the one (bucket, 2·target·k) merge, each on its device
+                if warm_buckets:
+                    self._warm_impl(succ, warm_buckets, ks=ks,
+                                    sample=warm_data)
+                step: dict = {"action": action, "from": S, "to": target,
+                              "rows_moved": rows_moved}
+
+                if publisher is not None:
+                    # the registry's pre-flip seam: its bucket warm runs
+                    # the NEW topology's full hook (sealed scans on their
+                    # pinned devices + the successor-count merge), then
+                    # the commit runs as the last pre-flip hook, and only
+                    # then does the registry pointer flip — in-flight
+                    # flushes drain on the topology they leased
+                    def commit_hook(_searcher, _ks, _step=step):
+                        out = self._commit_reshard(succ, snaps, target,
+                                                   action)
+                        _step.update(out)
+                        return out
+
+                    step["publish"] = publisher.publish(
+                        name, self._searcher_for(succ), k=ks,
+                        warm_data=warm_data, res=res,
+                        warm_hook=commit_hook)
+                else:
+                    if warm_buckets:
+                        self._rehearse(succ, warm_buckets, ks, warm_data)
+                    step.update(self._commit_reshard(succ, snaps, target,
+                                                     action))
+                if metrics._enabled:
+                    _c_migrations().inc(1, name=self._name, action=action,
+                                        phase="completed")
+                    _c_rows_moved().inc(rows_moved, name=self._name)
+                    _h_reshard().observe(time.perf_counter() - t0,
+                                         name=self._name, action=action)
+                step["wall_s"] = round(time.perf_counter() - t0, 3)
+                return step
+            finally:
+                with self._lock:
+                    self._migration = None
+
+    def _commit_reshard(self, successors, snaps, target: int,
+                        action: str) -> dict:
+        """The atomic flip. Pre-lock: each successor gets its baseline
+        atomic snapshot + a fresh WAL (durability armed). Under the mesh
+        write lock: carry over every write that landed on a donor after
+        its fold snapshot (deletes first, then the delta tail — the
+        alive-bit re-read discipline of a compaction swap), swap the
+        shard list, and commit the manifest (its ``os.replace`` is the
+        durable commit point — a crash before it recovers to the old
+        topology, whose donors logged every mid-migration write; no write
+        is admitted between the swap and the manifest because the lock is
+        held). Post-lock: donor ledger entries retire (the audit proves
+        the migration's double-buffer frees once leases drain) and the
+        old epoch's files are removed."""
+        new_epoch = self._topology_epoch + 1
+        if self._wal_dir is not None:
+            from .wal import WriteAheadLog
+
+            for t, sh in enumerate(successors):
+                snap, wal_path = self._shard_files(t, epoch=new_epoch)
+                # stale files of an earlier ABORTED migration at this
+                # epoch (the manifest never committed them) must not be
+                # mistaken for live state
+                if os.path.exists(wal_path):
+                    os.remove(wal_path)
+                if isinstance(sh, ReplicatedShard):
+                    sh.save(snap)
+                else:
+                    _mut.save(sh, snap)
+                sh._wal = WriteAheadLog(wal_path, name=sh.name)
+                sh._snapshot_path = snap
+        carried = 0
+        with self._lock:
+            for donor, snap_n, snap_gids, dead0 in snaps:
+                # re-pick the carry-over twin NOW: the fold's primary may
+                # have gone stale mid-migration — a stale twin stops
+                # receiving (still-acknowledged) group writes, so reading
+                # its tail would silently drop them; any currently
+                # non-stale twin received every group write (lockstep),
+                # at the same delta offsets and tombstone counts, so the
+                # fold's snap_n and watermarks transfer
+                prim = (donor._primary()
+                        if isinstance(donor, ReplicatedShard) else donor)
+                st = prim._state
+                dead_now = (int(st.sealed_dead_n),
+                            snap_n
+                            - int(np.count_nonzero(st.delta_alive[:snap_n])))
+                if dead_now == dead0:
+                    # no snapshot-live id died mid-migration (the common
+                    # case): skip the O(live-rows) membership scan — this
+                    # runs under the mesh write lock, stalling every write
+                    dead = np.empty(0, np.int64)
+                elif len(prim._loc):
+                    live_now = np.fromiter(prim._loc.keys(), np.int64,
+                                           count=len(prim._loc))
+                    dead = np.sort(snap_gids[
+                        np.isin(snap_gids, live_now, invert=True)])
+                else:
+                    dead = np.sort(snap_gids)
+                tail = (np.nonzero(st.delta_alive[snap_n:st.delta_n])[0]
+                        + snap_n)
+                tail_ids = st.delta_ids[tail].astype(np.int64)
+                tail_rows = st.delta[tail].copy()
+                if dead.size:
+                    owner = shard_of(dead, target)
+                    for t in np.unique(owner):
+                        successors[int(t)].delete(dead[owner == t])
+                    carried += int(dead.size)
+                if tail_ids.size:
+                    owner = shard_of(tail_ids, target)
+                    for t in np.unique(owner):
+                        m2 = owner == t
+                        # an id upserted mid-migration tombstones its
+                        # snapshot copy in the successor here (and lands
+                        # in the successor WAL — durable before the flip)
+                        successors[int(t)].upsert(tail_rows[m2],
+                                                  ids=tail_ids[m2])
+                    carried += int(tail_ids.size)
+            old_shards = self._shards
+            self._shards = list(successors)
+            self._topology_epoch = new_epoch
+            try:
+                faults.fire("reshard/flip", name=self._name,
+                            epoch=new_epoch)
+                if self._wal_dir is not None:
+                    faults.fire("reshard/manifest", name=self._name,
+                                epoch=new_epoch)
+                    self._write_manifest(self._wal_dir)
+            except BaseException:
+                # a manifest that failed to LAND (ENOSPC, EIO — a raise,
+                # not a crash) must not leave the mesh flipped in memory
+                # while the durable manifest still names the old topology:
+                # later acknowledged writes would land only in successor
+                # WALs recovery never reads. Roll the swap back — donors
+                # are untouched (carry-over only read them) and keep
+                # logging, so the abort loses nothing and reshard() keeps
+                # its mesh-untouched-on-raise contract.
+                self._shards = old_shards
+                self._topology_epoch = new_epoch - 1
+                if self._wal_dir is not None:
+                    for sh in successors:
+                        if sh._wal is not None:
+                            sh._wal.close()
+                            sh._wal = None
+                raise
+            self._update_gauges()
+        # off the write lock: donor retirement and the old epoch's files —
+        # the manifest is durable, nothing references them anymore
+        for sh in old_shards:
+            self._retire_shard(sh)
+        if self._wal_dir is not None:
+            for j in range(len(old_shards)):
+                for path in self._shard_files(j, epoch=new_epoch - 1):
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        return {"epoch": new_epoch, "carried_over": carried}
+
+    def _retire_shard(self, sh) -> None:
+        """Donor-shard retirement: obs.mem entries retire — a retired
+        entry still accounted after draining leases release the old
+        topology is exactly the leak the audit reports — and WAL handles
+        close (their files are gone; the successor logs own durability
+        now)."""
+        reps = (sh.replicas if isinstance(sh, ReplicatedShard) else (sh,))
+        for rep in reps:
+            obs_mem.retire(rep._state.mem)
+            obs_mem.retire(rep._sealed_mem)
+            if rep._wal is not None:
+                rep._wal.close()
+                rep._wal = None
+        if isinstance(sh, ReplicatedShard) and sh._wal is not None:
+            sh._wal.close()
+            sh._wal = None
+
+    def _rehearse(self, shards, buckets, ks, sample) -> None:
+        """Library-mode pre-flip warm of the successors' SEALED programs:
+        run the real new-topology scatter-gather at every (bucket, k),
+        once per replica ordinal so every twin's per-device executables
+        compile before failover can pick them. (The publisher path gets
+        this from the registry's bucket warm instead.)"""
+        import jax
+
+        from .._warmup import _random_queries
+
+        R = max((sh.n_replicas if isinstance(sh, ReplicatedShard) else 1)
+                for sh in shards)
+        key = jax.random.key(7)
+        for r in range(R):
+            states = tuple(
+                (sh.replicas[min(r, sh.n_replicas - 1)]._state
+                 if isinstance(sh, ReplicatedShard) else sh._state)
+                for sh in shards)
+            for kk in ks:
+                for b in sorted(set(int(x) for x in buckets)):
+                    key, kq = jax.random.split(key)
+                    q = _random_queries(kq, b, self.dim, self.query_dtype,
+                                        sample=sample)
+                    jax.block_until_ready(self._scatter_gather(
+                        states, q, int(kk), _view_scan))
+
+    # -- mesh durability -----------------------------------------------------
+    def save(self, dir: str | None = None) -> None:
+        """Atomic mesh snapshot: every shard's full mutable state
+        (:func:`raft_tpu.stream.save` — per-shard atomic with
+        parent-directory fsync, WAL-truncating when durability is armed)
+        plus the topology MANIFEST written LAST through
+        ``core.serialize.atomic_write``. A crash anywhere mid-save leaves
+        a loadable set: each shard pair (snapshot + WAL) is independently
+        consistent — the snapshot stamps the ``wal_seq`` it covers and
+        truncates only after its own rename is durable — and the manifest
+        only ever references complete pairs. ``dir`` defaults to (and,
+        when durability is armed, must be) the construction-time
+        ``wal_dir``."""
+        if dir is None:
+            dir = self._wal_dir
+        expects(dir is not None,
+                "save() needs a directory (pass dir= or construct with "
+                "wal_dir=)")
+        dir = os.fspath(dir)
+        if self._wal_dir is not None:
+            expects(os.path.abspath(dir) == os.path.abspath(self._wal_dir),
+                    "a durable mesh snapshots into its wal_dir (%r) — the "
+                    "per-shard WALs truncate against exactly these files; "
+                    "got %r", self._wal_dir, dir)
+        os.makedirs(dir, exist_ok=True)
+        # serialize with topology changes (and staggered folds): a reshard
+        # committing mid-save would close donor WALs under our per-shard
+        # saves and flip _shards/_topology_epoch between the snapshot loop
+        # and the manifest — the lock makes a save see one topology whole
+        with self._compact_lock:
+            for s, sh in enumerate(self._shards):
+                snap, _ = self._shard_files(s, dir=dir)
+                if isinstance(sh, ReplicatedShard):
+                    sh.save(snap)
+                else:
+                    _mut.save(sh, snap)
+            self._write_manifest(dir)
+
+    def _write_manifest(self, dir: str) -> None:
+        from ..core.serialize import (atomic_write, serialize_header,
+                                      serialize_scalar)
+
+        e = self._topology_epoch
+        with atomic_write(os.path.join(dir, _MANIFEST)) as f:
+            serialize_header(f, "mesh")
+            serialize_scalar(f, self._name)
+            serialize_scalar(f, len(self._shards))
+            serialize_scalar(f, int(e))
+            serialize_scalar(f, int(self._replicas_n))
+            serialize_scalar(f, int(self._next_id))
+            for s, sh in enumerate(self._shards):
+                sn, wn = self._shard_names(s, e)
+                serialize_scalar(f, sn)
+                serialize_scalar(f, wn if self._wal_dir is not None else "")
+                serialize_scalar(f, int(sh._wal_seq))
+
+    @classmethod
+    def load(cls, dir, *, build: Callable | None = None,
+             search_params=None, index_params=None,
+             builder: Callable | None = None,
+             devices: Sequence | None = None, comms=None,
+             fencing: FencingPolicy | None = None,
+             name: str | None = None,
+             clock: Callable[[], float] = time.monotonic
+             ) -> "ShardedMutableIndex":
+        """Recover a mesh from :meth:`save`'s manifest + per-shard
+        snapshots (+ per-shard WAL replay when durability was armed).
+        The manifest decides the topology: a crash mid-reshard — before
+        the manifest's atomic rename — recovers to the OLD topology, each
+        shard's log replayed past its snapshot's stamp through the
+        ordinary write path, so no acknowledged write is lost and no
+        unacknowledged write resurrected. Runtime configuration
+        (``build`` — needed only to reshard again —
+        ``search_params``/``index_params``/``builder``/``devices``/
+        ``comms``/``fencing``) is supplied fresh, like every loader.
+
+        A replicated mesh recovers DEGRADED-TO-ONE: the group snapshot is
+        the primary twin's state (twins are in-memory redundancy; the log
+        is the on-disk copy), so every acknowledged write comes back on a
+        ``replicas=1`` surface — re-replicate by rebuilding the mesh
+        around the recovered corpus. ``mesh.last_recovery`` aggregates
+        the per-shard replay reports (``replayed``, ``topology_epoch``,
+        ``degraded_from_replicas``)."""
+        from ..core.serialize import check_header, deserialize_scalar
+
+        dir = os.fspath(dir)
+        if comms is not None:
+            expects(devices is None, "pass devices= or comms=, not both")
+            devices = list(comms.mesh.devices.flat)
+        if devices is not None:
+            devices = list(devices)
+        with open(os.path.join(dir, _MANIFEST), "rb") as f:
+            check_header(f, "mesh")
+            saved_name = deserialize_scalar(f)
+            n_shards = int(deserialize_scalar(f))
+            epoch = int(deserialize_scalar(f))
+            saved_replicas = int(deserialize_scalar(f))
+            next_id = int(deserialize_scalar(f))
+            entries = [(deserialize_scalar(f), deserialize_scalar(f),
+                        int(deserialize_scalar(f)))
+                       for _ in range(n_shards)]
+        obj = cls.__new__(cls)
+        obj._name = saved_name if name is None else name
+        obj._clock = clock
+        obj._lock = threading.RLock()
+        obj._compact_lock = threading.Lock()
+        obj._build_fn = build
+        obj._search_params = search_params
+        obj._index_params = index_params
+        obj._builder = builder
+        obj._retain_vectors = None
+        obj._devices = devices
+        obj._replicas_n = 1  # degraded-to-one restore (see docstring)
+        obj._fencing = fencing
+        obj._topology_epoch = epoch
+        obj._migration = None
+        has_wal = any(wname for _, wname, _ in entries)
+        obj._wal_dir = dir if has_wal else None
+        shards = []
+        for j, (sname, wname, _seq) in enumerate(entries):
+            shards.append(_mut.load(
+                os.path.join(dir, sname),
+                wal=os.path.join(dir, wname) if wname else None,
+                search_params=search_params, index_params=index_params,
+                builder=builder, shard=j,
+                device=(devices[j % len(devices)] if devices else None),
+                clock=clock))
+        obj._shards = shards
+        obj._delta_capacity = shards[0].delta_capacity
+        obj._next_id = max([next_id] + [sh._next_id for sh in shards])
+        obj._finish_init()
+        per = [getattr(sh, "last_recovery", None) for sh in shards]
+        obj.last_recovery = {
+            "n_shards": n_shards, "topology_epoch": epoch,
+            "replayed": sum(p["replayed"] for p in per if p),
+            "torn": any(p["torn"] for p in per if p),
+            "degraded_from_replicas": saved_replicas,
+            "per_shard": per,
+        }
+        return obj

@@ -42,6 +42,17 @@ Fault points in the tree (grep ``faults.fire`` for the live list):
 - ``serialize/atomic-write`` — fired between writing the temp file and the
   ``os.replace`` in :func:`raft_tpu.core.serialize.atomic_write`: a crash
   here must leave the previous snapshot readable.
+- ``reshard/split`` — fired per donor fold inside
+  :meth:`raft_tpu.stream.ShardedMutableIndex.reshard` (ctx: ``donors``,
+  ``action``), BEFORE the successors are built: a crash mid-migration
+  leaves the mesh (and its on-disk manifest) on the old topology.
+- ``reshard/flip`` — fired between the in-memory topology swap and the
+  manifest write: the commit-window crash — recovery reads the OLD
+  manifest and replays the donor shards' WALs, losing nothing (no write
+  is admitted inside the window; the mesh write lock is held).
+- ``reshard/manifest`` — fired immediately before the topology manifest's
+  atomic write: a crash here also recovers to the old topology (the
+  manifest's ``os.replace`` is the durable commit point of a reshard).
 
 Every helper is thread-safe; ``fire`` holds no lock on the disarmed fast
 path. Injected exceptions should derive from :class:`FaultError` (or any

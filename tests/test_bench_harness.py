@@ -798,6 +798,60 @@ def test_crash_recovery_row():
     assert row["compile_s_post_warm"] == 0.0, row
 
 
+def test_reshard_churn_row():
+    """The --reshard elasticity row (ISSUE 13 acceptance): a loaded
+    replicated mesh doubles its shard count online with one replica
+    killed mid-migration. The row body asserts the acceptance bits itself
+    (zero failed queries, strikes observed, zero cold compiles after
+    rehearsal, recall held across the flip); the small-scale twin must
+    come back clean with the measured crash-mid-reshard recovery."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_reshard_churn(rows, n=4000, d=16, n_lists=32, k=5,
+                             n_probes=8, steps=16, qbatch=16, reshard_at=8,
+                             write_every=4, write_rows=8,
+                             delta_capacity=512, n_eval=32, readers=2)
+    row = rows[-1]
+    assert row["name"] == "reshard_churn_100k" and "error" not in row, rows
+    assert row["failed_queries"] == 0, row
+    assert row["shards_from"] == 2 and row["shards_to"] == 4, row
+    assert row["strikes"] > 0, row
+    assert row["compile_s_loaded"] == 0.0, row
+    assert row["rows_moved"] >= 4000, row
+    assert row["carried_over"] >= 1, row  # the mid-migration write moved
+    assert row["recall_post"] >= row["recall_pre"] - 0.02, row
+    assert row["recall_crash_recovered"] == 1.0, row
+    assert row["crash_recovery_s"] > 0, row
+    assert row["wal_records_replayed"] > 0, row
+    assert row["qps"] > 0 and row["replicas"] == 2, row
+
+
+def test_reshard_flag_runs_only_the_reshard_row(monkeypatch):
+    """`bench.py --reshard` is the elasticity iteration loop: setup + the
+    reshard row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_reshard_churn",
+        lambda rows: rows.append({"name": "reshard_churn_100k",
+                                  "failed_queries": 0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--reshard"])
+        assert rc == 0 and calls == ["setup"]
+        names = {r.get("name") for r in bench._STATE["rows"]}
+        assert "reshard_churn_100k" in names
+    finally:
+        bench._STATE["rows"].clear()
+
+
 def test_fault_smoke_flag_runs_only_the_fault_rows(monkeypatch):
     """`bench.py --fault-smoke` is the availability iteration loop: setup
     + the two fault rows, nothing else."""
