@@ -16,7 +16,10 @@ recall-like field (``recall``, ``recall_mut``, ...) falls more than
 artifact are reported but never gate (new rows appear every round); a row
 that errored in the NEW artifact but not the old is a regression, and so
 is a QPS/recall field present in the old row but missing from the new —
-a lost measurement must not pass as "ok".
+a lost measurement must not pass as "ok". The per-tier ``mem.tiers.*``
+sub-fields (rows served through a TieredStore) gate the same way on
+PRESENCE: byte levels shift legitimately between runs, but a tier
+measurement the old artifact had and the new lost fails the gate.
 
 Accepts both the committed driver wrapper (``{n, cmd, rc, tail, parsed}``)
 and a bare bench snapshot (``{metric, value, rows, ...}``); an artifact
@@ -47,6 +50,24 @@ def load_rows(artifact: dict) -> dict:
 def _recall_keys(row: dict):
     return sorted(k for k, v in row.items()
                   if k.startswith("recall") and isinstance(v, (int, float)))
+
+
+def _tier_keys(row: dict):
+    """Per-tier ``mem`` sub-fields (``mem.tiers.device`` ...): present in
+    a row whose scope held a live TieredStore. Gated like recall fields —
+    PRESENCE only (byte levels shift legitimately run to run, but a lost
+    tier measurement must fail, not pass silently)."""
+    tiers = row.get("mem", {}).get("tiers", {}) if isinstance(
+        row.get("mem"), dict) else {}
+    return sorted(k for k, v in tiers.items()
+                  if isinstance(v, (int, float)))
+
+
+def _tier_get(row: dict, key: str):
+    mem = row.get("mem")
+    if not isinstance(mem, dict) or not isinstance(mem.get("tiers"), dict):
+        return None
+    return mem["tiers"].get(key)
 
 
 def compare(old: dict, new: dict, *, qps_tol: float = 0.15,
@@ -104,6 +125,18 @@ def compare(old: dict, new: dict, *, qps_tol: float = 0.15,
                 check["regression"] = True
                 row["status"] = "regression"
             row["checks"].append(check)
+        for key in _tier_keys(o):
+            got = _tier_get(n, key)
+            if not isinstance(got, (int, float)):
+                row["status"] = "regression"
+                row["checks"].append({"field": f"mem.tiers.{key}",
+                                      "old": o["mem"]["tiers"][key],
+                                      "new": None, "missing": True,
+                                      "regression": True})
+            else:
+                row["checks"].append({"field": f"mem.tiers.{key}",
+                                      "old": o["mem"]["tiers"][key],
+                                      "new": got})
         out["rows"].append(row)
         if row["status"] == "regression":
             out["regressions"].append(name)

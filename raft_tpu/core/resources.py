@@ -56,12 +56,26 @@ class Resources:
         at gate time — the ledger does not account under
         ``obs.disable()``, so an armed budget there raises ``RaftError``
         instead of silently not enforcing.
+      host_budget_bytes: HARD budget for TIERED raw-row stores in host
+        RAM (``None`` = unenforced, the default) — the RAM half of the
+        beyond-HBM tiering story: a ``storage="tiered"`` index keeps its
+        full-precision refine rows in host RAM, and this is the budget
+        those rows admit against at store construction, through the same
+        :func:`raft_tpu.obs.mem.gate` and with the same whole-or-nothing
+        ``MemoryBudgetError`` taxonomy as the device budget. Scope is the
+        tiered stores ONLY (they dominate host bytes at beyond-HBM
+        scale); the stream layer's smaller host arrays — delta
+        memtables, bitsets, id maps — are ledger-visible
+        (``raft_tpu_mem_host_bytes``) but not yet gated. Stores placed
+        on disk (``TierPolicy.disk_path``) price nothing here — mmap
+        pages are disk-backed.
     """
 
     device: Optional[Any] = None
     mesh: Optional[jax.sharding.Mesh] = None
     workspace_bytes: int = 2 << 30
     memory_budget_bytes: Optional[int] = None
+    host_budget_bytes: Optional[int] = None
     # Free-form registry for user extensions — the residue of the reference's
     # type-keyed resource factory map (core/resources.hpp:91-124).
     _registry: dict = dataclasses.field(default_factory=dict, repr=False)

@@ -64,7 +64,12 @@ __all__ = [
 #       (shard count, topology epoch, per-shard snapshot/WAL names and
 #       wal_seq; raft_tpu.stream.ShardedMutableIndex save/load and the
 #       reshard commit point). Every other section is unchanged from /10.
-SERIALIZATION_VERSION = "raft_tpu/11"
+#   raft_tpu/12: the "stream" section carries the tier layout — storage
+#       policy ("hbm"/"tiered") + the store's residency tier at save time
+#       (raft_tpu.stream.tiered), so load() restores placement without
+#       re-deciding; /11 files read back as storage="hbm". Every other
+#       section is unchanged from /11.
+SERIALIZATION_VERSION = "raft_tpu/12"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
@@ -74,17 +79,21 @@ SERIALIZATION_VERSION = "raft_tpu/11"
 _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                            "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                           "raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
+                           "raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
+                           "raft_tpu/11"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
                          "raft_tpu/6", "raft_tpu/7", "raft_tpu/8",
-                         "raft_tpu/9", "raft_tpu/10"}),
+                         "raft_tpu/9", "raft_tpu/10", "raft_tpu/11"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                         "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
-                        "raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
-    "stream": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
-    "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10"}),
-    # "mesh" is new in /11 — no older layout exists to accept
-    "mesh": frozenset(),
+                        "raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
+                        "raft_tpu/11"}),
+    "stream": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
+                         "raft_tpu/11"}),
+    "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
+                              "raft_tpu/11"}),
+    # "mesh" is new in /11 — that is the oldest layout it accepts
+    "mesh": frozenset({"raft_tpu/11"}),
 }
 
 

@@ -18,14 +18,17 @@ from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..distance.types import DistanceType, resolve_metric
 
-__all__ = ["refine"]
+__all__ = ["refine", "refine_gathered"]
 
 
-@functools.partial(jax.jit, static_argnames=("k", "metric"))
-def _refine(dataset, queries, candidates, k: int, metric: DistanceType):
+def _score_candidates(cand_vecs, queries, candidates, k: int,
+                      metric: DistanceType):
+    """Exact re-rank of PRE-GATHERED candidate rows — the scoring body
+    shared (traced, not called) by both jitted entry points, so the
+    all-HBM gather-inside-jit path and the tiered host-gather path run
+    the IDENTICAL scoring program (the tiered-vs-HBM bit-parity contract
+    rides on it)."""
     valid = candidates >= 0  # negative ids = padding slots
-    safe = jnp.maximum(candidates, 0)
-    cand_vecs = jnp.take(dataset, safe, axis=0)  # (m, k0, d)
     q = queries[:, None, :].astype(jnp.float32)
     c = cand_vecs.astype(jnp.float32)
     if metric == DistanceType.InnerProduct:
@@ -47,6 +50,19 @@ def _refine(dataset, queries, candidates, k: int, metric: DistanceType):
     return top_v, ids.astype(jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine(dataset, queries, candidates, k: int, metric: DistanceType):
+    safe = jnp.maximum(candidates, 0)
+    cand_vecs = jnp.take(dataset, safe, axis=0)  # (m, k0, d)
+    return _score_candidates(cand_vecs, queries, candidates, k, metric)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "metric"))
+def _refine_gathered(cand_vecs, queries, candidates, k: int,
+                     metric: DistanceType):
+    return _score_candidates(cand_vecs, queries, candidates, k, metric)
+
+
 def refine(dataset, queries, candidates, k: int, metric="sqeuclidean", res: Resources | None = None):
     """Re-rank ``candidates`` (m, k0) by exact distance; return the top
     ``k <= k0`` (reference: neighbors/refine.cuh, pylibraft
@@ -61,3 +77,25 @@ def refine(dataset, queries, candidates, k: int, metric="sqeuclidean", res: Reso
     expects(k <= candidates.shape[1], "k must be <= candidate width")
     mt = resolve_metric(metric)
     return _refine(dataset, queries, candidates, int(k), mt)
+
+
+def refine_gathered(cand_vecs, queries, candidates, k: int,
+                    metric="sqeuclidean"):
+    """:func:`refine` over candidate rows ALREADY gathered to the device
+    — the tiered-storage refine epilogue: the (m, k0, d) ``cand_vecs``
+    arrive through :meth:`raft_tpu.stream.tiered.TieredStore.fetch`'s
+    double-buffered host→device hop (or a device-mirror gather), and this
+    runs exactly the scoring program :func:`refine` traces after its own
+    in-jit gather — same k0 candidates, bit-identical distances. Negative
+    ``candidates`` are padding: their (arbitrary) gathered row is masked,
+    sorts last, and surfaces as id ``-1``."""
+    queries = jnp.asarray(queries)
+    cand_vecs = jnp.asarray(cand_vecs)
+    candidates = jnp.asarray(candidates).astype(jnp.int32)
+    expects(candidates.ndim == 2 and candidates.shape[0] == queries.shape[0],
+            "candidates must be (n_queries, k0)")
+    expects(cand_vecs.shape[:2] == candidates.shape,
+            "cand_vecs must be (n_queries, k0, d) matching candidates")
+    expects(k <= candidates.shape[1], "k must be <= candidate width")
+    return _refine_gathered(cand_vecs, queries, candidates, int(k),
+                            resolve_metric(metric))

@@ -69,7 +69,8 @@ __all__ = [
     "MemLedger", "ledger", "account", "account_index", "release", "retire",
     "reaccount", "totals", "reset_peak", "breakdown", "audit", "plan",
     "gate", "unaccounted_index_bytes", "hbm_stats", "note_workspace",
-    "debug_payload",
+    "debug_payload", "register_pressure_handler",
+    "register_debug_section", "gate_host",
 ]
 
 
@@ -548,7 +549,8 @@ def _ivf_capacity(rows: int, n_lists: int, split_factor: float) -> int:
 
 
 def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
-         dtype: str = "float32") -> dict:
+         dtype: str = "float32", storage: str = "hbm",
+         tier=None) -> dict:
     """Predict the long-lived (serve) device bytes and a coarse build peak
     for an index of ``kind`` over ``(rows, dim)`` data — the sizing half of
     memory-budget-aware planning (docs/serving.md "Capacity planning" for
@@ -556,10 +558,24 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
     ``None`` for defaults; ``brute_force`` takes none). Accuracy contract:
     ``index_bytes`` within ±20% of the measured ledger at 100k+ rows for
     all four kinds (pinned in tier-1; the dominant arrays are exact, the
-    slack is IVF list padding).
+    slack is IVF list padding) — per TIER under ``storage="tiered"``.
+
+    ``storage="tiered"`` grows the estimate per tier: the index's own
+    scan structures stay device-resident (the device figure is UNCHANGED
+    — for brute-force/CAGRA that includes their stored dataset, which IS
+    their scan operand), and the host/disk tier prices the RETAINED
+    raw-row store (``rows x dim x B``) a ``MutableIndex(storage=
+    "tiered")`` wrap keeps cold — a real, separate copy for every kind
+    (it feeds rebuild compaction, the exact oracle and IVF-PQ's refine
+    epilogue), landing on host RAM or on disk when ``tier`` (a
+    :class:`raft_tpu.stream.tiered.TierPolicy`) sets ``disk_path``. The
+    budget gates price the DEVICE figure only; host bytes gate against
+    ``Resources.host_budget_bytes``.
 
     Returns ``{"kind", "rows", "dim", "index_bytes", "build_peak_bytes",
-    "breakdown": {array: bytes}}``.
+    "breakdown": {array: bytes}, "tiers": {"device", "host", "disk"}}``
+    (``index_bytes`` stays the device figure — the budget-gate
+    comparator).
     """
     from ..core.errors import expects
 
@@ -645,22 +661,73 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
         raise RaftError(
             f"plan(): unknown index kind {kind!r} (expected brute_force, "
             "ivf_flat, ivf_pq or cagra)")
+    expects(storage in ("hbm", "tiered"),
+            "plan() storage must be 'hbm' or 'tiered', got %r", storage)
+    tiers = {"device": int(sum(bk.values())), "host": 0, "disk": 0}
+    if storage == "tiered":
+        raw = rows * dim * item  # the full-precision refine rows
+        cold = ("disk" if getattr(tier, "disk_path", None) is not None
+                else "host")
+        tiers[cold] = raw
+        bk[f"tier_{cold}_rows"] = raw
     return {"kind": kind, "rows": rows, "dim": dim,
-            "index_bytes": int(sum(bk.values())),
-            "build_peak_bytes": int(build_peak), "breakdown": bk}
+            "index_bytes": tiers["device"],
+            "build_peak_bytes": int(build_peak), "breakdown": bk,
+            "tiers": tiers}
 
 
 # -- budget gate -------------------------------------------------------------
 
-def gate(res, need_bytes, *, site: str, detail: str = "") -> None:
-    """Admission check against ``res.memory_budget_bytes``: refuse when
-    the ledger's accounted device bytes plus ``need_bytes`` would exceed
-    the budget. A ``None`` budget (the default) is a single attribute
-    check — the gate costs nothing unless armed. ``need_bytes`` may be a
-    callable (evaluated only when armed — plan() is not free). Raises
+# budget-pressure relief: callables ``fn(need_bytes) -> freed_bytes`` the
+# gate consults ONCE before refusing a device admission — how tiered
+# stores (raft_tpu.stream.tiered) spill their device mirrors to make room
+# for a write instead of shedding it. Handlers must never raise (the gate
+# swallows nothing) and must only drop REBUILDABLE state (caches).
+_pressure_handlers: list = []
+
+# extra /debug/mem sections: key -> zero-arg payload callable (the tiered
+# registry contributes "tiers"); a failing provider is skipped — a debug
+# endpoint must never take the process down
+_debug_sections: dict = {}
+
+
+def register_pressure_handler(fn) -> None:
+    """Register a budget-pressure relief hook (see ``_pressure_handlers``
+    above). Idempotent per callable."""
+    if fn not in _pressure_handlers:
+        _pressure_handlers.append(fn)
+
+
+def register_debug_section(key: str, fn) -> None:
+    """Register an extra ``/debug/mem`` payload section under ``key``."""
+    _debug_sections[str(key)] = fn
+
+
+def _relieve(need_bytes: int) -> None:
+    for fn in list(_pressure_handlers):
+        try:
+            fn(int(need_bytes))
+        except Exception:  # relief is best-effort; the re-check decides
+            pass
+
+
+def gate(res, need_bytes, *, site: str, detail: str = "",
+         host_bytes=0) -> None:
+    """Admission check against ``res.memory_budget_bytes`` (device) and
+    ``res.host_budget_bytes`` (host): refuse when the ledger's accounted
+    bytes plus the projected growth would exceed the armed budget. Both
+    budgets default ``None`` = a single attribute check — the gate costs
+    nothing unless armed. ``need_bytes``/``host_bytes`` may be callables
+    (evaluated only when armed — plan() is not free). Raises
     :class:`raft_tpu.serve.errors.MemoryBudgetError` BEFORE the caller
     touches any state (whole-or-nothing; the error carries ``site`` /
     ``budget_bytes`` / ``accounted_bytes`` / ``need_bytes``).
+
+    A device overage consults the registered PRESSURE HANDLERS once
+    before refusing: a tiered store's device mirror is a cache, and
+    spilling a cache (a counted, ``/debug/mem``-visible event) beats
+    shedding the admission — only if the re-check still exceeds the
+    budget does the gate raise.
 
     An armed budget REQUIRES observability: under ``obs.disable()`` the
     ledger stops accounting, so every gate would compare against a frozen
@@ -668,31 +735,83 @@ def gate(res, need_bytes, *, site: str, detail: str = "") -> None:
     — three dark builds would each see 0 used and all admit. That is a
     configuration error and fails loudly here rather than enforcing a
     budget that does not hold."""
+    from ..core.errors import RaftError
+
     budget = getattr(res, "memory_budget_bytes", None)
+    host_budget = getattr(res, "host_budget_bytes", None)
+    if budget is None and host_budget is None:
+        return
+    if not metrics._enabled:
+        raise RaftError(
+            f"memory_budget_bytes/host_budget_bytes is set but "
+            f"observability is disabled: the ledger the budget gates "
+            f"against does not account under obs.disable(), so "
+            f"enforcement at {site!r} would be silently void — "
+            "obs.enable() or unset the budget")
+    if budget is not None:
+        need = int(need_bytes() if callable(need_bytes) else need_bytes)
+        used = _ledger.totals()["device_bytes"]
+        if used + need > int(budget):
+            # budget pressure: let registered relief (tier spills) free
+            # device bytes, then re-check once
+            _relieve(used + need - int(budget))
+            used = _ledger.totals()["device_bytes"]
+        if used + need > int(budget):
+            _c_refusals().inc(1, site=site)
+            from ..serve.errors import MemoryBudgetError
+
+            raise MemoryBudgetError(
+                f"memory budget exceeded at {site}: accounted {used} B + "
+                f"needed {need} B > budget {int(budget)} B"
+                + (f" ({detail})" if detail else ""),
+                site=site, budget_bytes=int(budget), accounted_bytes=used,
+                need_bytes=need)
+    if host_budget is not None:
+        need_h = int(host_bytes() if callable(host_bytes) else host_bytes)
+        used_h = _ledger.totals()["host_bytes"]
+        # zero host need always admits: every DEVICE-side caller reaches
+        # here with the host_bytes=0 default, and un-gated host growth
+        # (delta memtables, bitsets — ledger-visible but not admitted
+        # here) must not turn those into refusals. The device side has
+        # the OPPOSITE pinned contract (budgets armed after builds land
+        # refuse zero-growth publishes) — do not unify them.
+        if need_h and used_h + need_h > int(host_budget):
+            _c_refusals().inc(1, site=f"{site}/host")
+            from ..serve.errors import MemoryBudgetError
+
+            raise MemoryBudgetError(
+                f"host memory budget exceeded at {site}: accounted "
+                f"{used_h} B + needed {need_h} B > host budget "
+                f"{int(host_budget)} B"
+                + (f" ({detail})" if detail else ""),
+                site=f"{site}/host", budget_bytes=int(host_budget),
+                accounted_bytes=used_h, need_bytes=need_h)
+
+
+def gate_host(res, host_bytes, *, site: str, detail: str = "") -> None:
+    """The HOST half of :func:`gate` alone — for admissions that add
+    zero device bytes (a tiered store's cold rows). The device budget
+    deliberately does NOT run here: its cumulative check refuses any
+    growth while the ledger sits over budget (the budgets-armed-late
+    contract), which must not fail an operation that allocates no device
+    memory at all — e.g. the successor store of a compaction fold while
+    the double-buffered predecessor epoch is still accounted."""
+    budget = getattr(res, "host_budget_bytes", None)
     if budget is None:
         return
     if not metrics._enabled:
         from ..core.errors import RaftError
 
         raise RaftError(
-            f"memory_budget_bytes is set but observability is disabled: "
-            f"the ledger the budget gates against does not account under "
-            f"obs.disable(), so enforcement at {site!r} would be silently "
-            "void — obs.enable() or unset the budget")
-    need = int(need_bytes() if callable(need_bytes) else need_bytes)
-    used = _ledger.totals()["device_bytes"]
-    if used + need <= int(budget):
-        return
-    if metrics._enabled:
-        _c_refusals().inc(1, site=site)
-    from ..serve.errors import MemoryBudgetError
+            f"host_budget_bytes is set but observability is disabled: "
+            f"enforcement at {site!r} would be silently void — "
+            "obs.enable() or unset the budget")
 
-    raise MemoryBudgetError(
-        f"memory budget exceeded at {site}: accounted {used} B + needed "
-        f"{need} B > budget {int(budget)} B"
-        + (f" ({detail})" if detail else ""),
-        site=site, budget_bytes=int(budget), accounted_bytes=used,
-        need_bytes=need)
+    class _HostOnly:
+        host_budget_bytes = int(budget)
+        memory_budget_bytes = None
+
+    gate(_HostOnly(), 0, site=site, detail=detail, host_bytes=host_bytes)
 
 
 # -- /debug/mem payload ------------------------------------------------------
@@ -700,7 +819,9 @@ def gate(res, need_bytes, *, site: str, detail: str = "") -> None:
 def debug_payload(top: int = 20) -> dict:
     """The ``/debug/mem`` JSON: totals + peaks, per-component aggregates,
     the ``top`` largest allocations (component/name/shard/epoch), audit
-    status, and per-device HBM stats where the backend has them."""
+    status, per-device HBM stats where the backend has them, plus every
+    registered extra section (``tiers`` — per-store residency, tier
+    bytes and spill/promote events — once a tiered store is live)."""
     rows = _ledger.breakdown()
     by_comp: dict[str, dict] = {}
     for r in rows:
@@ -713,6 +834,12 @@ def debug_payload(top: int = 20) -> dict:
         hbm = hbm_stats()
     except Exception:  # a debug endpoint must never take the process down
         hbm = {}
-    return {"totals": _ledger.totals(), "by_component": by_comp,
-            "top": rows[:int(top)], "audit": _ledger.audit(),
-            "hbm": hbm}
+    out = {"totals": _ledger.totals(), "by_component": by_comp,
+           "top": rows[:int(top)], "audit": _ledger.audit(),
+           "hbm": hbm}
+    for key, fn in list(_debug_sections.items()):
+        try:
+            out[key] = fn()
+        except Exception:  # a debug endpoint must never take the process down
+            pass
+    return out

@@ -35,6 +35,14 @@ fresh/sealed split of FreshDiskANN (Singh et al. 2021; PAPERS.md):
   (:class:`FencingPolicy`). One dead replica = degraded capacity, never
   a failed query (:class:`~raft_tpu.serve.errors.ReplicaUnavailableError`
   only when EVERY twin is out).
+- :class:`TieredStore` / :class:`TierPolicy` — beyond-HBM storage
+  (``MutableIndex(storage="tiered")``): PQ codes + coarse structures stay
+  in HBM while full-precision refine rows live in host RAM (or an mmap'd
+  on-disk file), crossing to the device as double-buffered per-batch
+  gathers for ``search_refined``'s exact-refine epilogue and the chunked
+  exact oracle. Placement is decided against
+  ``Resources.memory_budget_bytes`` (budget-pressure spill, hit-rate
+  promote), visible at ``/debug/mem`` + ``raft_tpu_tier_*``.
 - :class:`ShardedMutableIndex` — the same lifecycle scatter-gathered
   across a mesh: S device-pinned shards with hash-routed writes
   (:func:`shard_of`), one ``select_k`` merge over every shard's
@@ -58,19 +66,21 @@ docs/observability.md. The serve write path
 points for the failover/replay suites: :mod:`raft_tpu.testing.faults`.
 """
 
-from . import compactor, mutable, replicated, sharded, wal
+from . import compactor, mutable, replicated, sharded, tiered, wal
 from .compactor import CompactionPolicy, Compactor
 from .mutable import (DELTA_MIN_BUCKET, DeltaFullError, MutableIndex,
                       delta_buckets, load, save)
 from .replicated import FencingPolicy, ReplicatedShard
 from .sharded import ShardedMutableIndex, shard_of
+from .tiered import TieredStore, TierPolicy
 from .wal import WalCorruptError, WriteAheadLog
 
 __all__ = [
-    "mutable", "compactor", "sharded", "replicated", "wal",
+    "mutable", "compactor", "sharded", "replicated", "tiered", "wal",
     "MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET", "delta_buckets",
     "ShardedMutableIndex", "shard_of",
     "ReplicatedShard", "FencingPolicy",
+    "TieredStore", "TierPolicy",
     "WriteAheadLog", "WalCorruptError",
     "Compactor", "CompactionPolicy",
     "save", "load",

@@ -57,6 +57,7 @@ from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..serve.errors import OverloadedError
 from ..testing import faults
+from .tiered import TieredStore, TierPolicy
 
 __all__ = ["MutableIndex", "DeltaFullError", "DELTA_MIN_BUCKET",
            "delta_buckets", "save", "load"]
@@ -183,6 +184,16 @@ def _sealed_meta(kind, sealed):
             dk = "float32"
         return n, d, resolve_metric(sealed.metric), float(sealed.metric_arg), dk
     return (sealed.size, sealed.dim, sealed.metric, 2.0, sealed.data_kind)
+
+
+def _store_rows(store) -> np.ndarray | None:
+    """The raw rows of a retained store as a host array: a plain ``hbm``
+    store IS the array, a :class:`~raft_tpu.stream.tiered.TieredStore`
+    exposes its cold copy — compaction folds, drift sampling and
+    serialization all read rows through this one seam."""
+    if store is None:
+        return None
+    return store.host_view() if isinstance(store, TieredStore) else store
 
 
 def _recover_store(kind, sealed, data_kind):
@@ -482,8 +493,16 @@ class MutableIndex:
     lives on mesh device ``s`` and only candidate tuples ever leave it.
     ``shard`` (optional) is the shard ordinal for obs.mem ledger
     attribution — the sharded tier passes its index so ``/debug/mem``
-    breaks bytes down per shard. ``clock`` is injected for deterministic
-    tests (the age watermark's time base).
+    breaks bytes down per shard. ``storage`` picks where the retained
+    raw rows live: ``"hbm"`` (default — the pre-tiering behavior, a
+    host array with a lazy full device copy for the oracle) or
+    ``"tiered"`` (:class:`~raft_tpu.stream.tiered.TieredStore`: rows in
+    host RAM or an mmap'd file per ``tier`` — a
+    :class:`~raft_tpu.stream.tiered.TierPolicy` — with refine/oracle
+    batches crossing to the device through a double-buffered gather;
+    see :meth:`search_refined` and docs/streaming.md "Tiered storage").
+    ``clock`` is injected for deterministic tests (the age watermark's
+    time base).
     """
 
     def __init__(self, sealed, *, search_params=None, index_params=None,
@@ -492,6 +511,8 @@ class MutableIndex:
                  ids=None, device=None, name: str = "default",
                  shard: int | None = None, wal=None,
                  snapshot_path: str | None = None,
+                 storage: str = "hbm", tier: TierPolicy | None = None,
+                 tier_residency: str | None = None,
                  clock: Callable[[], float] = time.monotonic):
         kind, module = _resolve_kind(sealed)
         n, d, metric, metric_arg, data_kind = _sealed_meta(kind, sealed)
@@ -587,12 +608,33 @@ class MutableIndex:
             expects(store is not None,
                     "retain_vectors=True needs dataset= for %s (stored codes "
                     "cannot reconstruct raw rows)", kind)
+        # the beyond-HBM storage policy (docs/streaming.md "Tiered
+        # storage"): "tiered" keeps the full-precision rows cold (host
+        # RAM / disk mmap) behind a TieredStore — the refine epilogue and
+        # the exact oracle then cross to the device per batch instead of
+        # pinning a second full-precision copy in HBM
+        expects(storage in ("hbm", "tiered"),
+                "storage must be 'hbm' or 'tiered', got %r", storage)
+        expects(tier is None or storage == "tiered",
+                "tier= (a TierPolicy) applies to storage='tiered' only")
+        expects(tier_residency is None or storage == "tiered",
+                "tier_residency= applies to storage='tiered' only")
+        if storage == "tiered":
+            expects(store is not None,
+                    "storage='tiered' stores the raw refine rows cold — "
+                    "pass dataset= (IVF kinds) or retain_vectors=True")
+        self._storage = storage
+        self._tier = tier
 
         st = _StreamState(cfg)
         st.sealed = sealed
         st.id_map = id_map
         st.sealed_alive = np.ones(n, bool)
-        st.store = store
+        # tier_residency (load()'s layout-restore seam) skips the
+        # placement decision entirely — re-deciding here and correcting
+        # later would pay a full wasted H2D for a cold-saved store
+        st.store = self._make_store(store, epoch=0,
+                                    residency=tier_residency)
         dt = _np_dtype(query_dtype)
         st.delta = np.zeros((self.delta_capacity, d), dt)
         st.delta_ids = np.zeros(self.delta_capacity, np.int32)
@@ -645,12 +687,37 @@ class MutableIndex:
             return int(len(st.sealed_alive) - st.sealed_dead_n
                        + st.delta_alive[:st.delta_n].sum())
 
+    def _make_store(self, rows, epoch: int, residency: str | None = None):
+        """Materialize the retained row store for one state epoch: the
+        raw array under ``storage="hbm"``, a
+        :class:`~raft_tpu.stream.tiered.TieredStore` under ``"tiered"``
+        (per-epoch — a compaction successor's store re-places against the
+        budget, carrying the predecessor's residency when asked, which is
+        how tier residency migrates through the fold-and-swap)."""
+        if rows is None or self._storage == "hbm":
+            return rows
+        return TieredStore(
+            np.asarray(rows), name=self._cfg.name, shard=self._shard,
+            epoch=epoch, policy=self._tier, device=self._cfg.device,
+            residency=residency, clock=self._clock)
+
+    @property
+    def storage(self) -> str:
+        """The storage policy ("hbm" or "tiered")."""
+        return self._storage
+
+    @property
+    def tiered_store(self) -> TieredStore | None:
+        """The live epoch's :class:`TieredStore` (None under "hbm")."""
+        st = self._state.store
+        return st if isinstance(st, TieredStore) else None
+
     def _drift_store(self):
         """The retained raw-row store (or None) — what a
         :class:`~raft_tpu.stream.Compactor` feeds the corpus-side drift
         detector; the sharded tier overrides this with a cross-shard
         subsample."""
-        return self._state.store
+        return _store_rows(self._state.store)
 
     def stats(self) -> dict:
         with self._lock:
@@ -695,7 +762,9 @@ class MutableIndex:
             dev.append(st.store_dev)
         host = [st.delta, st.delta_ids, st.delta_alive, st.sealed_alive,
                 st.id_map]
-        if st.store is not None:
+        # a TieredStore carries its own "tier" ledger entry (rows + mirror
+        # + gather slots) — ONE attribution, not a second copy here
+        if st.store is not None and not isinstance(st.store, TieredStore):
             host.append(st.store)
         if st.mem is None:
             st.mem = obs_mem.account(
@@ -881,23 +950,87 @@ class MutableIndex:
         # before the sealed keep-mask (pairs with upsert's kill-then-reveal)
         delta, dkeep, dids, _ = st.delta_view
         skeep, imap = st.sealed_keep_dev, st.id_map_dev
-        store_dev = self._store_device(st)
         queries = jnp.asarray(queries)
         expects(queries.ndim == 2 and queries.shape[1] == cfg.dim,
                 "queries must be (rows, %d)", cfg.dim)
         if cfg.query_dtype == "float32":
             queries = queries.astype(jnp.float32)
         k = int(k)
-        ks = min(k, store_dev.shape[0])
-        sd, si = brute_force.knn(store_dev, queries, ks, cfg.metric,
-                                 cfg.metric_arg, sample_filter=skeep, res=res)
-        si = _map_ids(si, imap)
+        ts = st.store if isinstance(st.store, TieredStore) else None
+        # mirror SNAPSHOT: a concurrent pressure spill nulls ts.mirror
+        # from a writer thread — one read decides the branch AND supplies
+        # the array, so a spill mid-query degrades to the chunked path's
+        # next call instead of failing this one
+        mirror = ts.mirror if ts is not None else None
+        if ts is not None and mirror is None:
+            # cold tiered store: chunked scan through the double-buffered
+            # slot ring — the oracle covers the full corpus with ZERO net
+            # device row bytes (satellite: the canary's shadow-rerank must
+            # not duplicate the store on device). The keep-mask is COPIED
+            # once here — sealed_alive mutates in place under writes, and
+            # a per-chunk live read could miss an id in BOTH parts (delta
+            # snapshot too old, sealed bit already killed); one copy taken
+            # AFTER the delta view preserves the kill-then-reveal pairing
+            # exactly like the resident path's frozen device mask
+            alive = st.sealed_alive.copy()
+            sd, si = self._chunked_store_scan(st, ts, queries, k,
+                                              alive=alive, res=res)
+            si = _map_ids(si, imap)
+        else:
+            store_dev = (mirror if mirror is not None
+                         else self._store_device(st))
+            ks = min(k, store_dev.shape[0])
+            sd, si = brute_force.knn(store_dev, queries, ks, cfg.metric,
+                                     cfg.metric_arg, sample_filter=skeep,
+                                     res=res)
+            si = _map_ids(si, imap)
         kd = min(k, delta.shape[0])
         dd, di = brute_force.knn(delta, queries, kd, cfg.metric,
                                  cfg.metric_arg, sample_filter=dkeep, res=res)
         di = _map_ids(di, dids)
         obs_dispatch.note(4)  # store scan + delta scan + two id maps
         return sd, si, dd, di
+
+    def _chunked_store_scan(self, st: _StreamState, ts: TieredStore,
+                            queries, k: int, *, alive=None, res=None,
+                            max_chunks: int | None = None):
+        """Exact scan of a COLD tiered store: fixed-shape chunks stream
+        through the store's replacement slot ring (chunk N+1's upload overlaps
+        chunk N's distance compute under async dispatch) and fold into a
+        running top-k through the same ``_merge`` program the serving path
+        uses. Every chunk shares one program set — (chunk, k) knn + shift
+        + merge — so store size never compiles on the oracle path after
+        :meth:`warm`. Returns ``(sd, si)`` in STORE-SLOT id space (the
+        caller maps to global ids); the tombstone keep-mask rides each
+        chunk's ``sample_filter`` exactly like the resident scan.
+        ``max_chunks`` bounds the walk (the warm path compiles the
+        program set with two chunks instead of scanning everything)."""
+        from ..neighbors import brute_force
+        from . import tiered as _tiered
+
+        cfg = st.cfg
+        chunk = ts.oracle_chunk
+        kc = min(int(k), chunk)
+        n_chunks = ts.n_oracle_chunks()
+        if max_chunks is not None:
+            n_chunks = min(n_chunks, int(max_chunks))
+        if alive is None:  # warm path; real scans pass the caller's copy
+            alive = st.sealed_alive.copy()
+        acc_d = acc_i = None
+        for ci in range(n_chunks):
+            rows_dev, base, valid = ts.oracle_chunk_dev(ci)
+            keep = np.zeros(chunk, bool)
+            keep[:valid] = alive[base:base + valid]
+            cd, cidx = brute_force.knn(
+                rows_dev, queries, kc, cfg.metric, cfg.metric_arg,
+                sample_filter=_dev_put(cfg, keep), res=res)
+            cidx = _tiered.shift_slots(cidx, base)
+            if acc_d is None:
+                acc_d, acc_i = cd, cidx
+            else:
+                acc_d, acc_i = _merge(acc_d, acc_i, cd, cidx, kc,
+                                      cfg.select_min)
+        return acc_d, acc_i
 
     def _store_device(self, st: _StreamState):
         """The epoch-frozen device copy of the retained row store (lazy;
@@ -906,6 +1039,9 @@ class MutableIndex:
         expects(st.store is not None,
                 "exact_search needs the retained row store "
                 "(retain_vectors=True / dataset= at wrap time)")
+        expects(not isinstance(st.store, TieredStore),
+                "tiered stores never materialize a second full device "
+                "copy — use the mirror or the chunked scan")
         dev = st.store_dev
         if dev is None:
             dev = _dev_put(st.cfg, st.store)
@@ -914,6 +1050,163 @@ class MutableIndex:
             # serving hot path by construction)
             self._account_state(st)
         return dev
+
+    # -- the refine epilogue (tiered storage's serving path) -----------------
+    def search_refined(self, queries, k: int, refine_ratio: int = 4,
+                       res=None):
+        """IVF-PQ search with the exact-refine epilogue restructured as a
+        store gather: the sealed scan widens to ``k * refine_ratio`` PQ
+        candidates, their full-precision rows gather from the retained
+        store — under ``storage="tiered"`` a double-buffered host→device
+        hop (:meth:`TieredStore.fetch`; batch N+1's H2D overlaps batch
+        N's distance compute), under ``"hbm"`` a device-side gather from
+        the resident copy — and :func:`raft_tpu.neighbors.refine
+        .refine_gathered` re-ranks exactly; the delta memtable (already
+        exact) merges at serving width. Identical ids/distances across
+        the two storage modes (bit-parity pinned by the ``tiering``
+        suite): tiering moves WHERE the rows live, never what a query
+        answers. Returns ``(distances (m, k), global ids (m, k))``."""
+        return self._search_refined_state(self._state, queries, k,
+                                          refine_ratio, res=res)
+
+    def _search_refined_state(self, st: _StreamState, queries, k: int,
+                              refine_ratio: int, res=None):
+        from ..obs import requestlog
+
+        rd, ri, dd, di = self._refined_scan(queries, k, refine_ratio,
+                                            res=res, st=st)
+        t0 = time.perf_counter()
+        out = _merge(rd, ri, dd, di, int(k), self._cfg.select_min)
+        requestlog.add_span("stream/merge", time.perf_counter() - t0)
+        return out
+
+    def _refined_scan(self, queries, k: int, refine_ratio: int, res=None,
+                      st: _StreamState | None = None):
+        """The scatter half of :meth:`search_refined` — refined sealed
+        part + exact delta part, global ids, BEFORE the merge — so the
+        sharded tier composes per-shard refined scans through its one
+        ``select_k`` dispatch. Snapshot order matches :func:`_scan_state`
+        (delta view before the sealed keep-mask). ``st`` pins an explicit
+        state epoch (the :meth:`refined_searcher` hook's lease-drain
+        contract); None reads the live state."""
+        from ..neighbors import brute_force
+        from ..neighbors.refine import refine_gathered
+        from ..obs import requestlog
+        from . import tiered as _tiered
+
+        if st is None:
+            st = self._state
+        cfg = self._cfg
+        expects(cfg.kind == "ivf_pq",
+                "search_refined is the IVF-PQ refine epilogue (kind=%r "
+                "scores candidates exactly already — use search())",
+                cfg.kind)
+        expects(st.store is not None,
+                "search_refined needs the retained raw rows (dataset= / "
+                "retain_vectors=True at wrap time)")
+        r = int(refine_ratio)
+        expects(r >= 1, "refine_ratio must be >= 1, got %d", r)
+        jnp = _jnp()
+        requestlog.annotate("stream_epoch", st.epoch)
+        delta, dkeep, dids, _ = st.delta_view
+        skeep, imap = st.sealed_keep_dev, st.id_map_dev
+        queries = jnp.asarray(queries)
+        expects(queries.ndim == 2 and queries.shape[1] == cfg.dim,
+                "queries must be (rows, %d)", cfg.dim)
+        if cfg.query_dtype == "float32":
+            queries = queries.astype(jnp.float32)
+        k = int(k)
+        kr = min(k * r, st.id_map.shape[0])
+        t0 = time.perf_counter()
+        # PQ candidates at the widened width — approximate distances are
+        # DISCARDED; only the slot ids feed the exact re-rank
+        _, slots = cfg.module.search(cfg.search_params, st.sealed, queries,
+                                     kr, sample_filter=skeep, res=res)
+        t1 = time.perf_counter()
+        ts = st.store if isinstance(st.store, TieredStore) else None
+        if ts is not None:
+            cand = ts.fetch(slots, res=res)
+        else:
+            cand = _tiered.mirror_gather(self._store_device(st), slots)
+        ks = min(k, kr)
+        rd, rslots = refine_gathered(cand, queries, slots, ks,
+                                     metric=cfg.metric)
+        ri = _map_ids(rslots, imap)
+        t2 = time.perf_counter()
+        kd = min(k, delta.shape[0])
+        dd, di = brute_force.knn(delta, queries, kd, cfg.metric,
+                                 cfg.metric_arg, sample_filter=dkeep,
+                                 res=res)
+        di = _map_ids(di, dids)
+        obs_dispatch.note(5)
+        requestlog.add_span("stream/sealed", t1 - t0)
+        requestlog.add_span("tier/refine", t2 - t1)
+        requestlog.add_span("stream/delta", time.perf_counter() - t2)
+        return rd, ri, dd, di
+
+    def refined_searcher(self, refine_ratio: int = 4):
+        """Serving hook over :meth:`search_refined` (the
+        ``batched_searcher`` contract) — what a tiered IVF-PQ index
+        publishes: PQ scan + store-gather refine as ONE hook, pinned to
+        the current state epoch exactly like :meth:`searcher` (a
+        compaction swap freezes the leased hook's view; the republish
+        picks up the successor — the registry lease-drain contract)."""
+        from ..neighbors._hooks import make_hook
+
+        st = self._state
+        fn = make_hook(
+            lambda queries, k: self._search_refined_state(st, queries, k,
+                                                          refine_ratio),
+            f"stream/{self._cfg.kind}+refine", self._cfg.dim,
+            self._cfg.data_kind)
+        fn.mutable = self
+        return fn
+
+    def warm_refined(self, buckets, ks=(10,), refine_ratio: int = 4,
+                     sample=None) -> dict:
+        """Rehearse the refined serving path per (query bucket, k): one
+        real :meth:`search_refined` per shape compiles the widened PQ
+        scan, the gather slots (filling the double-buffer ring), the
+        refine program and the merge — after which the ``tiering`` suite's
+        zero-cold-compile contract holds across refine double-buffer
+        cycles. Under tiered storage the chunked-oracle program set warms
+        too (two chunks — the knn/shift/merge triple compiles, the full
+        walk stays off the warm path). Returns per-(k, bucket) compile
+        attribution like :meth:`warm`."""
+        import jax
+
+        from .._warmup import _random_queries
+        from ..obs import compile as obs_compile
+
+        cfg = self._cfg
+        out: dict = {}
+        key = jax.random.key(0)
+        for kk in sorted(set(int(x) for x in ks)):
+            out[kk] = {}
+            for b in sorted(set(int(x) for x in buckets)):
+                key, kq = jax.random.split(key)
+                q = _random_queries(kq, b, cfg.dim, cfg.query_dtype,
+                                    sample=sample)
+                t0 = time.perf_counter()
+                with obs_compile.attribution() as rec:
+                    jax.block_until_ready(
+                        self.search_refined(q, kk, refine_ratio)[0])
+                    ts = self.tiered_store
+                    if ts is not None:
+                        # warm the CHUNKED oracle programs regardless of
+                        # current residency: a promoted store can be
+                        # pressure-spilled later, and its first post-
+                        # spill exact_search must not cold-compile the
+                        # chunk knn/shift/merge set mid-serve
+                        st = self._state
+                        jnp_q = _jnp().asarray(q)
+                        if cfg.query_dtype == "float32":
+                            jnp_q = jnp_q.astype(_jnp().float32)
+                        jax.block_until_ready(self._chunked_store_scan(
+                            st, ts, jnp_q, kk, max_chunks=2)[0])
+                out[kk][b] = {"wall_s": round(time.perf_counter() - t0, 3),
+                              **rec.summary()}
+        return out
 
     def searcher(self):
         """Serving hook pinned to the CURRENT state epoch (the
@@ -1037,11 +1330,13 @@ class MutableIndex:
                 else:
                     new_sealed = st.sealed
                 new_id_map = np.concatenate([st.id_map, fold_gids])
-                new_store = (np.concatenate([st.store, fold_rows])
+                new_store = (np.concatenate([_store_rows(st.store),
+                                             fold_rows])
                              if st.store is not None else None)
                 reclaimed = 0
             else:
-                live_rows = np.concatenate([st.store[s_src], fold_rows])
+                live_rows = np.concatenate([_store_rows(st.store)[s_src],
+                                            fold_rows])
                 expects(live_rows.shape[0] > 0,
                         "compaction would leave an empty index")
                 new_id_map = np.concatenate([st.id_map[s_src], fold_gids])
@@ -1093,7 +1388,16 @@ class MutableIndex:
                 nd = _StreamState(cfg)
                 nd.sealed = new_sealed
                 nd.id_map = new_id_map
-                nd.store = new_store
+                # tier residency MIGRATES through the fold-and-swap: the
+                # successor epoch's store re-places with the predecessor's
+                # residency (its promote still honors the budget — a
+                # squeezed successor degrades to cold instead of failing
+                # the compaction)
+                nd.store = self._make_store(
+                    new_store, epoch=st.epoch + 1,
+                    residency=(st.store.residency
+                               if isinstance(st.store, TieredStore)
+                               else None))
                 # alive bits re-read from the LIVE state: deletes that
                 # landed mid-fold are preserved across the swap
                 if mode == "extend":
@@ -1129,6 +1433,10 @@ class MutableIndex:
                 # SHOULD free once draining leases release it — a retired
                 # entry still accounted is the leak obs.mem.audit() reports
                 obs_mem.retire(old_state.mem)
+                if isinstance(old_state.store, TieredStore):
+                    # the pre-fold epoch's tier entry should free at drain
+                    # like every other retired epoch allocation
+                    old_state.store.retire()
                 if nd.sealed is not old_state.sealed:
                     old_sealed_mem = self._sealed_mem
                     self._sealed_mem = obs_mem.account_index(
@@ -1185,13 +1493,22 @@ def save(mutable: MutableIndex, path: str) -> None:
                 serialize_scalar(f, int(mutable._wal_seq))
             serialize_scalar(f, int(st.delta_n))
             serialize_scalar(f, st.store is not None)
+            if serialize.version_number(serialize.SERIALIZATION_VERSION) >= 12:
+                # the decided tier layout (raft_tpu/12): storage policy +
+                # the store's residency at save time, so load() restores
+                # placement without re-deciding (TierPolicy itself is
+                # runtime configuration, supplied fresh like search_params)
+                serialize_scalar(f, mutable._storage)
+                serialize_scalar(f, (st.store.residency
+                                     if isinstance(st.store, TieredStore)
+                                     else "device"))
             serialize_mdspan(f, st.id_map)
             serialize_mdspan(f, st.sealed_alive)
             serialize_mdspan(f, st.delta[:st.delta_n])
             serialize_mdspan(f, st.delta_ids[:st.delta_n])
             serialize_mdspan(f, st.delta_alive[:st.delta_n])
             if st.store is not None:
-                serialize_mdspan(f, st.store)
+                serialize_mdspan(f, _store_rows(st.store))
             cfg.module.write_index(f, st.sealed)
         if mutable._wal is not None:
             mutable._wal.reset()
@@ -1200,7 +1517,7 @@ def save(mutable: MutableIndex, path: str) -> None:
 def load(path: str, *, search_params=None, index_params=None,
          builder: Callable | None = None, name: str | None = None,
          device=None, wal=None, snapshot_path: str | None = None,
-         shard: int | None = None,
+         shard: int | None = None, tier: TierPolicy | None = None,
          clock: Callable[[], float] = time.monotonic) -> MutableIndex:
     """Load a :func:`save`d mutable index. ``search_params``/
     ``index_params``/``builder``/``device`` are runtime configuration (like
@@ -1233,6 +1550,10 @@ def load(path: str, *, search_params=None, index_params=None,
                    if version_number(ver) >= 10 else 0)
         delta_n = int(deserialize_scalar(f))
         has_store = bool(deserialize_scalar(f))
+        storage, residency = "hbm", None
+        if version_number(ver) >= 12:
+            storage = deserialize_scalar(f)
+            residency = deserialize_scalar(f)
         id_map = np.asarray(deserialize_mdspan(f))
         sealed_alive = np.asarray(deserialize_mdspan(f)).astype(bool)
         delta = np.asarray(deserialize_mdspan(f))
@@ -1243,10 +1564,18 @@ def load(path: str, *, search_params=None, index_params=None,
 
     if snapshot_path is None and wal is not None:
         snapshot_path = path
+    # the SAVED placement threads into construction instead of being
+    # re-decided: the layout is part of the snapshot (raft_tpu/12), so
+    # load + WAL replay + warm() comes back exactly as placed — no
+    # re-decision, no wasted upload-then-spill; a saved device residency
+    # that no longer fits the budget degrades to cold (promote() never
+    # raises), which the tier events make visible
     m = MutableIndex(sealed, search_params=search_params,
                      index_params=index_params, delta_capacity=capacity,
                      retain_vectors=has_store, dataset=store, builder=builder,
                      device=device, snapshot_path=snapshot_path, shard=shard,
+                     storage=storage, tier=tier,
+                     tier_residency=residency if storage == "tiered" else None,
                      name=saved_name if name is None else name, clock=clock)
     with m._lock:
         st = m._state

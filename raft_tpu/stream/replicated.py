@@ -210,6 +210,7 @@ class ReplicatedShard:
                  wal=None, snapshot_path: str | None = None,
                  policy: FencingPolicy = FencingPolicy(),
                  name: str = "default", shard: int | None = None,
+                 storage: str = "hbm", tier=None,
                  clock: Callable[[], float] = time.monotonic):
         n_replicas = int(n_replicas)
         expects(n_replicas >= 1, "n_replicas must be >= 1, got %d",
@@ -242,7 +243,8 @@ class ReplicatedShard:
                 retain_vectors=retain_vectors, dataset=dataset,
                 builder=builder, ids=ids,
                 device=devices[j] if devices is not None else None,
-                name=f"{name}/r{j}", shard=shard, clock=clock))
+                name=f"{name}/r{j}", shard=shard, storage=storage,
+                tier=tier, clock=clock))
         self._health = [_Health(policy.backoff_s) for _ in range(n_replicas)]
         # group-level durability: ONE log for the group's serialized write
         # stream (the twins are in-memory redundancy; the log is the disk

@@ -299,6 +299,7 @@ class ShardedMutableIndex:
                  fencing: FencingPolicy | None = None,
                  wal_dir: str | None = None,
                  name: str = "default",
+                 storage: str = "hbm", tier=None,
                  clock: Callable[[], float] = time.monotonic):
         dataset = np.asarray(dataset)
         expects(dataset.ndim == 2, "dataset must be (rows, d)")
@@ -342,6 +343,10 @@ class ShardedMutableIndex:
         self._builder = builder
         self._delta_capacity = int(delta_capacity)
         self._retain_vectors = retain_vectors
+        # the beyond-HBM policy, per shard: every shard's MutableIndex gets
+        # its own TieredStore, so mesh capacity = shards x (HBM + host)
+        self._storage = storage
+        self._tier = tier
         self._devices = devices
         self._replicas_n = R
         self._fencing = fencing
@@ -421,6 +426,7 @@ class ShardedMutableIndex:
                 device=(devices[s % len(devices)] if devices is not None
                         else None),
                 wal=wal, snapshot_path=snapshot_path,
+                storage=self._storage, tier=self._tier,
                 name=f"{self._name}/shard{s}", shard=s, clock=self._clock)
         # replica j of shard s lands on devices[s*R + j] (mod the mesh):
         # twins of one shard live on DIFFERENT devices — the anti-affinity
@@ -438,6 +444,7 @@ class ShardedMutableIndex:
             builder=self._builder, ids=gids_s,
             policy=self._fencing or FencingPolicy(),
             wal=wal, snapshot_path=snapshot_path,
+            storage=self._storage, tier=self._tier,
             name=f"{self._name}/shard{s}", shard=s, clock=self._clock)
 
     def _finish_init(self) -> None:
@@ -713,6 +720,54 @@ class ShardedMutableIndex:
             return sh._exact_scan(q, kk, res=res)
 
         return self._scatter_gather(shards, queries, k, scan, res=res)
+
+    def search_refined(self, queries, k: int, refine_ratio: int = 4,
+                       res=None):
+        """Scatter-gather :meth:`MutableIndex.search_refined` over the
+        mesh: each shard widens its PQ scan to ``k * refine_ratio``,
+        refines against its OWN tiered store (the per-shard host hop —
+        mesh refine capacity is shards × host bandwidth), and the
+        per-shard refined + delta parts merge through the same one
+        ``select_k`` dispatch as :meth:`search`. The 1-shard composition
+        is bit-equal to the plain index's ``search_refined`` (parity
+        suite)."""
+        shards = tuple(self._shards)
+        expects(all(not isinstance(sh, ReplicatedShard) for sh in shards),
+                "search_refined does not route replica groups yet — "
+                "serve replicas=1 shards tiered, or use search()")
+
+        def scan(sh, q, kk, res=None):
+            return sh._refined_scan(q, kk, refine_ratio, res=res)
+
+        return self._scatter_gather(shards, queries, k, scan, res=res)
+
+    def refined_searcher(self, refine_ratio: int = 4):
+        """Serving hook over :meth:`search_refined` (the
+        ``batched_searcher`` contract) — the sharded twin of
+        :meth:`MutableIndex.refined_searcher`: every shard's CURRENT
+        state epoch is pinned at hook creation (the same lease-drain
+        semantics as :meth:`searcher` — a staggered compaction or a
+        reshard flip freezes the leased hook's view; republish picks up
+        the successor)."""
+        from ..neighbors._hooks import make_hook
+
+        shards = tuple(self._shards)
+        expects(all(not isinstance(sh, ReplicatedShard) for sh in shards),
+                "refined_searcher does not route replica groups yet — "
+                "serve replicas=1 shards tiered, or use searcher()")
+        pinned = tuple((sh, sh._state) for sh in shards)
+        cfg0 = shards[0]._cfg
+
+        def scan(pin, q, kk, res=None):
+            sh, st = pin
+            return sh._refined_scan(q, kk, refine_ratio, res=res, st=st)
+
+        fn = make_hook(
+            lambda queries, k: self._scatter_gather(pinned, queries, k,
+                                                    scan),
+            f"stream/sharded/{cfg0.kind}+refine", cfg0.dim, cfg0.data_kind)
+        fn.mutable = self
+        return fn
 
     def searcher(self):
         """Serving hook pinned to every shard's CURRENT state epoch (the
@@ -992,7 +1047,8 @@ class ShardedMutableIndex:
                             d_live = np.nonzero(
                                 st.delta_alive[:snap_n])[0]
                             rows = np.concatenate(
-                                [st.store[s_live], st.delta[d_live]])
+                                [_mut._store_rows(st.store)[s_live],
+                                 st.delta[d_live]])
                             gids = np.concatenate(
                                 [st.id_map[s_live],
                                  st.delta_ids[d_live].astype(np.int64)])
@@ -1297,7 +1353,7 @@ class ShardedMutableIndex:
              builder: Callable | None = None,
              devices: Sequence | None = None, comms=None,
              fencing: FencingPolicy | None = None,
-             name: str | None = None,
+             name: str | None = None, tier=None,
              clock: Callable[[], float] = time.monotonic
              ) -> "ShardedMutableIndex":
         """Recover a mesh from :meth:`save`'s manifest + per-shard
@@ -1359,10 +1415,14 @@ class ShardedMutableIndex:
                 os.path.join(dir, sname),
                 wal=os.path.join(dir, wname) if wname else None,
                 search_params=search_params, index_params=index_params,
-                builder=builder, shard=j,
+                builder=builder, shard=j, tier=tier,
                 device=(devices[j % len(devices)] if devices else None),
                 clock=clock))
         obj._shards = shards
+        # per-shard stream sections carry the tier layout (raft_tpu/12) —
+        # the mesh inherits whatever the shards restored
+        obj._storage = shards[0]._storage
+        obj._tier = tier
         obj._delta_capacity = shards[0].delta_capacity
         obj._next_id = max([next_id] + [sh._next_id for sh in shards])
         obj._finish_init()

@@ -42,6 +42,10 @@ Fault points in the tree (grep ``faults.fire`` for the live list):
 - ``serialize/atomic-write`` — fired between writing the temp file and the
   ``os.replace`` in :func:`raft_tpu.core.serialize.atomic_write`: a crash
   here must leave the previous snapshot readable.
+- ``tier/fetch`` — fired per tiered-store gather
+  (:meth:`raft_tpu.stream.tiered.TieredStore.fetch`; ctx: ``name``,
+  ``residency``): a crash mid-refine-hop must recover via ``load()`` +
+  WAL replay with id-for-id parity (the ``tiering`` suite pins it).
 - ``reshard/split`` — fired per donor fold inside
   :meth:`raft_tpu.stream.ShardedMutableIndex.reshard` (ctx: ``donors``,
   ``action``), BEFORE the successors are built: a crash mid-migration

@@ -747,6 +747,34 @@ def test_rows_carry_mem_field(monkeypatch):
         obs.enable()
 
 
+def test_rows_carry_mem_tiers_watermark():
+    """A row scope that held a TieredStore carries the per-tier WATERMARK
+    under mem.tiers even though the store was a frame local freed before
+    attribution attached (the live totals would read empty there); a
+    scope without one carries no tiers field."""
+    import numpy as np
+
+    import bench
+    from raft_tpu.stream.tiered import TieredStore
+
+    rows = []
+
+    def body():
+        ts = TieredStore(np.zeros((64, 8), np.float32),
+                         name="bench_probe_tier")
+        assert ts.tier_bytes()["host"] == 64 * 8 * 4
+        rows.append({"name": "tier_probe", "qps": 1.0})
+
+    bench._row_guard(rows, "tier_probe", body)
+    row = next(r for r in rows if r["name"] == "tier_probe")
+    assert row["mem"]["tiers"]["host"] >= 64 * 8 * 4, row
+
+    rows2 = []
+    bench._row_guard(rows2, "plain_probe",
+                     lambda: rows2.append({"name": "plain_probe"}))
+    assert "tiers" not in rows2[0]["mem"], rows2
+
+
 def test_fault_smoke_row():
     """The --fault-smoke availability row (ISSUE 11 acceptance): a
     replicated sharded mesh serves a loaded window during which one
@@ -949,6 +977,89 @@ def test_compare_gates_on_lost_measurements():
     # appear every round)
     ok = compare.compare(new, old)
     assert ok["regressions"] == []
+
+
+def test_tiered_row():
+    """The --tiered bench row (ISSUE 15 acceptance): the same corpus
+    served all-HBM vs tiered under a device budget the raw rows exceed.
+    Every acceptance bit lives IN the row body (bit-equal ids, flat
+    per-tier bytes, zero failed queries, zero cold compiles) — the
+    small-scale twin must come back clean with the host-hop cost and
+    per-tier attribution recorded."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_tiered(rows, n=20_000, d=32, n_lists=128, pq_dim=16, m=256,
+                      bucket=128, waves=3, ncl=200)
+    row = rows[-1]
+    assert row["name"] == "tiered_100k" and "error" not in row, rows
+    assert row["tier_residency"] == "host"
+    assert row["store_bytes"] > row["budget_bytes"] - row["tier_bytes"][
+        "device"], "the raw rows must exceed the device budget headroom"
+    assert row["failed_queries"] == 0
+    assert row["steady_compile_s"] == 0.0
+    assert row["steady_cache_misses"] == 0
+    assert row["recall"] == row["recall_hbm"]  # bit-equal twins
+    assert row["tier_bytes"]["host"] == row["store_bytes"]
+    assert row["h2d_bytes"] > 0 and row["host_hop_s"] >= 0.0
+    assert row["qps"] > 0 and row["qps_hbm"] > 0
+
+
+def test_tiered_flag_runs_only_the_tiered_row(monkeypatch):
+    """`bench.py --tiered` is the beyond-HBM iteration loop: setup + the
+    tiered row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_tiered",
+        lambda rows: rows.append({"name": "tiered_100k", "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--tiered"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "tiered_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()
+
+
+def test_compare_gates_lost_tier_measurement():
+    """The per-tier mem sub-fields gate like recall fields on PRESENCE: a
+    tier measurement the old artifact had and the new lost must FAIL (a
+    harness bug dropping the attribution cannot pass as 'ok'), while
+    byte-level drift between runs gates nothing."""
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([
+        {"name": "t", "qps": 100.0, "recall": 0.9,
+         "mem": {"device_bytes": 1, "tiers": {"device": 10, "host": 99}}},
+    ])
+    drifted = _artifact([
+        {"name": "t", "qps": 100.0, "recall": 0.9,
+         "mem": {"device_bytes": 5, "tiers": {"device": 77, "host": 1}}},
+    ])
+    assert compare.compare(old, drifted)["regressions"] == [], (
+        "byte drift must not gate — presence does")
+    for lost in (
+        {"mem": {"device_bytes": 1, "tiers": {"device": 10}}},  # host gone
+        {"mem": {"device_bytes": 1}},                           # tiers gone
+        {},                                                     # mem gone
+    ):
+        new = _artifact([{"name": "t", "qps": 100.0, "recall": 0.9, **lost}])
+        out = compare.compare(old, new)
+        assert out["regressions"] == ["t"], lost
+        assert any(c.get("missing") and c["field"].startswith("mem.tiers.")
+                   for r in out["rows"] for c in r["checks"]), out
+    # tiers the NEW artifact gained gate nothing
+    assert compare.compare(_artifact([{"name": "t", "qps": 1.0}]),
+                           old)["regressions"] == []
 
 
 def test_compare_table_and_exit_codes(tmp_path, capsys):

@@ -1,0 +1,574 @@
+"""Beyond-HBM tiered storage tests (tier-1 ``tiering`` marker, ISSUE 15).
+
+The contract under test: ``MutableIndex(storage="tiered")`` moves WHERE
+the full-precision refine rows live (host RAM / disk mmap, device only as
+double-buffered per-batch gathers), never what a query answers —
+
+- **bit parity** with the all-HBM twin on ids AND distances for
+  ``search_refined`` / ``exact_search`` / ``search`` under the same
+  upsert/delete/compact script, float and byte dtypes;
+- **spill-then-promote round trips** under an injected budget squeeze
+  (the obs.mem gate's pressure handler drops the mirror instead of
+  shedding the write; headroom lifts it back), every move a counted,
+  ``/debug/mem``-visible event;
+- **crash at the ``tier/fetch`` fault point** recovers via ``load()`` +
+  WAL replay with id-for-id parity against an uncrashed twin;
+- **zero cold compiles** across refine double-buffer cycles after the
+  rehearsal warm (compile attribution);
+- the canary's shadow-rerank (the exact oracle) adds **zero device row
+  bytes** — the chunked scan streams through the constant slot ring
+  instead of materializing a second full-precision copy;
+- ``save()``/``load()`` round-trips the tier layout at raft_tpu/12 with
+  /11 read-compat both directions;
+- ``obs.mem.plan(storage="tiered")`` prices per tier within the ±20%
+  contract (the dominant arrays are exact).
+
+Heavy 1M+ twins live in the slow manifest. Deterministic: injected
+clocks, seeded data, fault scopes — no wall-clock sleeps.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import serialize
+from raft_tpu.core.resources import Resources, default_resources
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.obs import compile as obs_compile
+from raft_tpu.obs import mem as obs_mem
+from raft_tpu.serve.errors import MemoryBudgetError
+from raft_tpu.stream import (MutableIndex, ShardedMutableIndex, TieredStore,
+                             TierPolicy)
+from raft_tpu.stream import load as stream_load
+from raft_tpu.stream import save as stream_save
+from raft_tpu.testing import faults
+
+pytestmark = pytest.mark.tiering
+
+N, D = 2048, 16
+PARAMS = ivf_pq.IndexParams(n_lists=32, pq_bits=4, pq_dim=8, seed=0)
+SP = ivf_pq.SearchParams(n_probes=8)
+POLICY = TierPolicy(oracle_chunk=512, auto_promote=False)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+@pytest.fixture(scope="module")
+def corpus(rng):
+    X = rng.standard_normal((N, D)).astype(np.float32)
+    Q = rng.standard_normal((32, D)).astype(np.float32)
+    return X, Q
+
+
+@pytest.fixture(scope="module")
+def sealed(corpus):
+    return ivf_pq.build(PARAMS, corpus[0])
+
+
+def _wrap(sealed, X, storage, name, **kw):
+    kw.setdefault("tier", POLICY if storage == "tiered" else None)
+    return MutableIndex(sealed, search_params=SP, index_params=PARAMS,
+                        dataset=X, storage=storage, name=name, **kw)
+
+
+def _churn(m, rng_seed=3):
+    """The one upsert/delete/compact script both twins replay."""
+    r = np.random.default_rng(rng_seed)
+    m.upsert(r.standard_normal((24, D)).astype(np.float32),
+             ids=np.arange(50_000, 50_024))
+    m.delete([1, 7, 50_003])
+    m.compact()
+    m.upsert(r.standard_normal((8, D)).astype(np.float32),
+             ids=np.arange(60_000, 60_008))
+    m.delete([60_001, 2])
+
+
+def _assert_bit_equal(a, b, what):
+    da, ia = np.asarray(a[0]), np.asarray(a[1])
+    db, ib = np.asarray(b[0]), np.asarray(b[1])
+    assert (ia == ib).all(), f"{what}: ids diverge"
+    assert (da == db).all(), f"{what}: distances diverge"
+
+
+def test_tiered_vs_hbm_bit_parity_f32(sealed, corpus):
+    """Same script, two storage policies, identical answers — including
+    through a compaction fold (tier residency migrates, results don't)."""
+    X, Q = corpus
+    a = _wrap(sealed, X, "hbm", "par_hbm")
+    b = _wrap(sealed, X, "tiered", "par_tiered")
+    assert b.tiered_store.residency == "host"
+    _assert_bit_equal(a.search_refined(Q, 10, 4), b.search_refined(Q, 10, 4),
+                      "refined pre-churn")
+    _churn(a)
+    _churn(b)
+    _assert_bit_equal(a.search(Q, 10), b.search(Q, 10), "search post-churn")
+    _assert_bit_equal(a.search_refined(Q, 10, 4), b.search_refined(Q, 10, 4),
+                      "refined post-churn")
+    _assert_bit_equal(a.exact_search(Q, 10), b.exact_search(Q, 10),
+                      "oracle post-churn")
+    # the fold carried the store over: still tiered, still cold
+    assert isinstance(b._state.store, TieredStore)
+    assert b.tiered_store.residency == "host"
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "int8"])
+def test_tiered_vs_hbm_bit_parity_bytes(rng, dtype):
+    """Byte-dtype twins: the store keeps rows in the serving dtype and
+    the refine re-rank scores the raw domain exactly on both paths."""
+    if dtype == "uint8":
+        X = rng.integers(0, 255, (1024, D), dtype=np.uint8)
+        Q = rng.integers(0, 255, (16, D), dtype=np.uint8)
+    else:
+        X = rng.integers(-127, 127, (1024, D), dtype=np.int8)
+        Q = rng.integers(-127, 127, (16, D), dtype=np.int8)
+    p = ivf_pq.IndexParams(n_lists=16, pq_bits=4, pq_dim=8, seed=0)
+    idx = ivf_pq.build(p, X)
+    a = MutableIndex(idx, search_params=SP, index_params=p, dataset=X,
+                     name=f"pb_hbm_{dtype}")
+    b = MutableIndex(idx, search_params=SP, index_params=p, dataset=X,
+                     storage="tiered", tier=POLICY,
+                     name=f"pb_tier_{dtype}")
+    _assert_bit_equal(a.search_refined(Q, 5, 4), b.search_refined(Q, 5, 4),
+                      f"{dtype} refined")
+    _assert_bit_equal(a.exact_search(Q, 5), b.exact_search(Q, 5),
+                      f"{dtype} oracle")
+
+
+def test_spill_then_promote_round_trip(sealed, corpus):
+    """An injected budget squeeze spills the mirror THROUGH the gate
+    (pressure handler — the write is admitted, not shed), and headroom
+    promotes it back; both moves are counted events and the ledger's
+    device total reflects the mirror's bytes each way."""
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "squeeze")
+    ts = m.tiered_store
+    assert ts.promote(force=True) and ts.mirror_resident
+    dev_with_mirror = obs_mem.totals()["device_bytes"]
+
+    # squeeze: any delta growth exceeds the budget -> the gate reclaims
+    # the mirror instead of refusing the upsert
+    res = Resources(memory_budget_bytes=dev_with_mirror + 1)
+    m.upsert(np.zeros((16, D), np.float32), ids=np.arange(70_000, 70_016),
+             res=res)
+    assert not ts.mirror_resident, "pressure must spill the mirror"
+    assert ts.stats()["spills"] == 1
+    assert ts.stats()["events"][-1]["reason"] == "pressure"
+    assert (obs_mem.totals()["device_bytes"]
+            < dev_with_mirror - ts.row_bytes // 2), (
+        "the ledger must see the mirror's bytes freed")
+
+    # the answers never changed
+    hbm = _wrap(sealed, X, "hbm", "squeeze_twin")
+    hbm.upsert(np.zeros((16, D), np.float32), ids=np.arange(70_000, 70_016))
+    _assert_bit_equal(hbm.search_refined(Q, 10, 4),
+                      m.search_refined(Q, 10, 4), "post-spill refined")
+
+    # headroom: promote comes back, and a too-tight budget refuses it
+    tight = Resources(memory_budget_bytes=obs_mem.totals()["device_bytes"]
+                      + ts.row_bytes // 2)
+    assert not ts.promote(res=tight), "promote without headroom must refuse"
+    roomy = Resources(memory_budget_bytes=obs_mem.totals()["device_bytes"]
+                      + 2 * ts.row_bytes)
+    assert ts.promote(res=roomy) and ts.mirror_resident
+    assert ts.stats()["promotes"] >= 1
+    _assert_bit_equal(hbm.search_refined(Q, 10, 4),
+                      m.search_refined(Q, 10, 4), "post-promote refined")
+
+
+def test_hit_rate_auto_promote(sealed, corpus):
+    """promote_min_hits cold fetches under an ARMED budget with headroom
+    lift the mirror; with NO budget armed the store must stay cold (no
+    safe ceiling — promoting a beyond-HBM store because it was queried
+    three times is the OOM tiering exists to avoid)."""
+    X, Q = corpus
+    m = MutableIndex(sealed, search_params=SP, dataset=X, storage="tiered",
+                     name="auto",
+                     tier=TierPolicy(oracle_chunk=512, promote_min_hits=2))
+    ts = m.tiered_store
+    for _ in range(4):
+        m.search_refined(Q, 10, 4)
+    assert not ts.mirror_resident, "no budget armed -> no auto-promote"
+    roomy = Resources(memory_budget_bytes=obs_mem.totals()["device_bytes"]
+                      + 2 * ts.row_bytes)
+    m.search_refined(Q, 10, 4, res=roomy)
+    m.search_refined(Q, 10, 4, res=roomy)  # 2nd cold fetch trips promote
+    assert ts.mirror_resident, "hit-rate promote under budget headroom"
+    assert ts.stats()["events"][-1]["reason"] == "hit-rate"
+
+
+def test_tier_fetch_crash_recovers_via_wal(sealed, corpus, tmp_path):
+    """A crash mid-refine-hop (the ``tier/fetch`` fault point) recovers
+    through load() + WAL replay with id-for-id parity against an
+    uncrashed twin, and the restored index is still tiered."""
+    X, Q = corpus
+    snap = str(tmp_path / "t.idx")
+    wal = str(tmp_path / "t.wal")
+    m = _wrap(sealed, X, "tiered", "crash", wal=wal, snapshot_path=snap)
+    stream_save(m, snap)  # baseline snapshot; the WAL covers what follows
+    m.upsert(np.ones((4, D), np.float32), ids=[90_000, 90_001, 90_002,
+                                               90_003])
+    m.delete([90_001, 5])
+    with faults.scope():
+        faults.inject("tier/fetch", exc=faults.SimulatedCrash("die"))
+        with pytest.raises(faults.SimulatedCrash):
+            m.search_refined(Q, 10, 4)
+        assert faults.fired("tier/fetch") == 1
+    del m
+    gc.collect()
+
+    twin = _wrap(sealed, X, "tiered", "crash_twin")
+    twin.upsert(np.ones((4, D), np.float32), ids=[90_000, 90_001, 90_002,
+                                                  90_003])
+    twin.delete([90_001, 5])
+    rec = stream_load(snap, search_params=SP, wal=wal, tier=POLICY)
+    assert rec.last_recovery["replayed"] == 2
+    assert rec.storage == "tiered" and rec.tiered_store is not None
+    _assert_bit_equal(twin.search_refined(Q, 10, 4),
+                      rec.search_refined(Q, 10, 4), "recovered refined")
+    _assert_bit_equal(twin.search(Q, 10), rec.search(Q, 10),
+                      "recovered search")
+
+
+def test_zero_cold_compiles_across_refine_cycles(sealed, corpus):
+    """After the rehearsal warm (warm_refined), refine double-buffer
+    cycles and oracle passes compile NOTHING — the slot-ring rotation and
+    the fixed chunk shape keep every program hot."""
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "warmz")
+    rep = m.warm_refined([Q.shape[0]], ks=(10,), refine_ratio=4)
+    assert rep[10][Q.shape[0]]["wall_s"] >= 0.0
+    import jax
+
+    with obs_compile.attribution() as rec:
+        for _ in range(4):  # > fetch_slots: the ring wraps and replaces
+            jax.block_until_ready(m.search_refined(Q, 10, 4)[0])
+        for _ in range(2):
+            jax.block_until_ready(m.exact_search(Q, 10)[0])
+    assert rec.cache_misses == 0 and rec.compile_s == 0.0, (
+        f"cold compile on the warmed tiered path: {rec.summary()}")
+
+
+def test_post_spill_oracle_compiles_nothing(sealed, corpus):
+    """warm_refined warms the chunked-oracle program set even while the
+    mirror is resident — a later pressure spill must not cold-compile
+    the chunk knn/shift/merge set on the first post-spill shadow-rerank
+    (regression: the warm skipped the chunked path when promoted)."""
+    import jax
+
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "spillwarm")
+    assert m.tiered_store.promote(force=True)
+    m.warm_refined([Q.shape[0]], ks=(10,), refine_ratio=4)
+    m.tiered_store.spill(reason="pressure")
+    with obs_compile.attribution() as rec:
+        jax.block_until_ready(m.exact_search(Q, 10)[0])
+        jax.block_until_ready(m.search_refined(Q, 10, 4)[0])
+    assert rec.cache_misses == 0 and rec.compile_s == 0.0, rec.summary()
+
+
+def test_oracle_adds_zero_device_row_bytes(sealed, corpus):
+    """The regression the shared store exists for: the canary's
+    shadow-rerank (exact oracle) over a tiered store must not grow
+    device bytes — the pre-tiering lazy oracle uploaded a FULL second
+    row copy. Also pins the single attribution: the rows are ledgered
+    once, under the tier entry, not again under the stream epoch."""
+    from raft_tpu.obs.quality import exact_oracle
+
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "canary_store")
+    oracle = exact_oracle(m)
+    import jax
+
+    jax.block_until_ready(oracle(Q, 10)[0])  # rehearsal: slots allocate
+    before = obs_mem.totals()["device_bytes"]
+    for _ in range(3):
+        jax.block_until_ready(oracle(Q, 10)[0])
+    assert obs_mem.totals()["device_bytes"] == before, (
+        "shadow-rerank grew device bytes under a tiered store")
+    assert m._state.store_dev is None, (
+        "a tiered epoch must never materialize the lazy oracle copy")
+    # one attribution: the tier entry owns the row bytes; the stream
+    # epoch's host bytes must NOT include a second copy of them
+    tier_rows = [r for r in obs_mem.breakdown()
+                 if r["component"] == "tier" and r["name"] == "canary_store"]
+    assert len(tier_rows) == 1
+    assert tier_rows[0]["host_bytes"] >= X.nbytes
+    stream_rows = [r for r in obs_mem.breakdown()
+                   if r["component"] == "stream"
+                   and r["name"] == "canary_store"]
+    assert stream_rows and stream_rows[0]["host_bytes"] < X.nbytes
+
+
+def test_compaction_migrates_residency_and_retires_old_store(sealed, corpus):
+    """The fold-and-swap carries tier residency to the successor store
+    and retires the predecessor's ledger entry — which must actually
+    free once nothing pins the old epoch (the PR 10 audit contract)."""
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "fold")
+    assert m.tiered_store.promote(force=True)
+    m.upsert(np.zeros((4, D), np.float32), ids=[80_000, 80_001, 80_002,
+                                                80_003])
+    m.compact()
+    ts = m.tiered_store
+    assert ts is not None and ts._epoch == 1
+    assert ts.mirror_resident, "residency must migrate through the fold"
+    gc.collect()
+    leaks = [r for r in obs_mem.audit(collect=True)["retired_unfreed"]
+             if r["component"] == "tier"]
+    assert not leaks, f"pre-fold tier entry leaked: {leaks}"
+
+
+def test_sharded_per_shard_tiered_stores(corpus):
+    """ShardedMutableIndex(storage="tiered") gives every shard its own
+    store (mesh capacity = shards x (HBM + host)); the 1-shard mesh is
+    bit-equal to the plain index's refined search."""
+    X, Q = corpus
+
+    def build(rows):
+        return ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_bits=4,
+                                               pq_dim=8, seed=0), rows)
+
+    mesh = ShardedMutableIndex(X, n_shards=2, build=build, search_params=SP,
+                               storage="tiered", tier=POLICY, name="mesh2")
+    stores = [sh.tiered_store for sh in mesh._shards]
+    assert all(ts is not None and ts.residency == "host" for ts in stores)
+    tiers = [r for r in obs_mem.breakdown() if r["component"] == "tier"
+             and r["name"].startswith("mesh2/")]
+    assert sorted(r["shard"] for r in tiers) == [0, 1], tiers
+    d_, i_ = mesh.search_refined(Q, 5, 4)
+    assert np.asarray(i_).shape == (Q.shape[0], 5)
+    assert (np.asarray(i_)[:, 0] >= 0).all()
+
+    one = ShardedMutableIndex(X, n_shards=1, build=build, search_params=SP,
+                              storage="tiered", tier=POLICY, name="mesh1")
+    plain = MutableIndex(build(X), search_params=SP, dataset=X,
+                         storage="tiered", tier=POLICY, name="mesh1_twin")
+    _assert_bit_equal(one.search_refined(Q, 5, 4),
+                      plain.search_refined(Q, 5, 4), "1-shard refined")
+
+
+def test_reshard_tiered_mesh(corpus):
+    """reshard() folds donor stores through the _store_rows seam — a
+    tiered mesh doubles its topology without touching answer parity
+    (regression: the donor fold indexed the TieredStore directly)."""
+    X, Q = corpus
+
+    def build(rows):
+        return ivf_pq.build(ivf_pq.IndexParams(n_lists=16, pq_bits=4,
+                                               pq_dim=8, seed=0), rows)
+
+    mesh = ShardedMutableIndex(X, n_shards=1, build=build, search_params=SP,
+                               storage="tiered", tier=POLICY, name="rshrd")
+    before = np.asarray(mesh.exact_search(Q, 10)[1])
+    mesh.reshard(2)
+    assert mesh.n_shards == 2
+    assert all(sh.tiered_store is not None for sh in mesh._shards)
+    # the exact oracle is quantization-free, so the doubled topology must
+    # answer id-for-id (the PQ serving path legitimately differs: the
+    # successors are fresh per-shard builds)
+    after = np.asarray(mesh.exact_search(Q, 10)[1])
+    assert (before == after).all()
+    d_, i_ = mesh.search_refined(Q, 5, 4)
+    assert (np.asarray(i_)[:, 0] >= 0).all()
+
+
+def test_refined_hook_pins_its_epoch(sealed, corpus):
+    """A leased refined hook keeps serving the pre-compaction view until
+    its lease drains — the same epoch-pin contract as searcher()."""
+    X, Q = corpus
+    m = _wrap(sealed, X, "tiered", "pinned_hook")
+    hook = m.refined_searcher(refine_ratio=4)
+    before = np.asarray(hook(Q, 10)[1])
+    m.upsert(np.full((4, D), 7.0, np.float32), ids=[95_000, 95_001,
+                                                    95_002, 95_003])
+    m.compact()
+    # the leased hook still serves the frozen pre-compaction epoch...
+    assert (np.asarray(hook(Q, 10)[1]) == before).all()
+    # ...while a fresh hook (what a republish leases) sees the successor
+    assert m.tiered_store._epoch == 1
+    fresh = m.refined_searcher(refine_ratio=4)
+    assert (np.asarray(fresh(Q, 10)[1])
+            == np.asarray(m.search_refined(Q, 10, 4)[1])).all()
+
+
+def test_disk_tier_mmap(sealed, corpus, tmp_path):
+    """TierPolicy(disk_path=...) keeps the cold majority on disk: host
+    ledger bytes ~0, tier bytes under "disk", answers unchanged."""
+    X, Q = corpus
+    pol = TierPolicy(disk_path=str(tmp_path / "cold"), oracle_chunk=512,
+                     auto_promote=False)
+    m = MutableIndex(sealed, search_params=SP, dataset=X, storage="tiered",
+                     tier=pol, name="cold_store")
+    ts = m.tiered_store
+    assert ts.residency == "disk"
+    tb = ts.tier_bytes()
+    assert tb["disk"] == X.nbytes and tb["host"] == 0
+    entry = [r for r in obs_mem.breakdown() if r["component"] == "tier"
+             and r["name"] == "cold_store"][0]
+    assert entry["host_bytes"] == 0, "mmap pages must not price as host RAM"
+    hbm = _wrap(sealed, X, "hbm", "cold_twin")
+    _assert_bit_equal(hbm.search_refined(Q, 10, 4),
+                      m.search_refined(Q, 10, 4), "disk refined")
+    # epoch files do not leak: the fold's successor writes .e1 and the
+    # collected predecessor's .e0 unlinks (a periodically-compacting
+    # disk-tiered index must not grow disk by store_bytes per fold)
+    import os
+
+    f0 = ts._disk_file
+    del ts  # the test must not be the thing pinning the pre-fold store
+    m.compact()
+    assert m.tiered_store._disk_file != f0
+    gc.collect()
+    assert not os.path.exists(f0), "pre-fold epoch file leaked"
+    assert os.path.exists(m.tiered_store._disk_file)
+
+
+def test_save_load_roundtrips_tier_layout(sealed, corpus, tmp_path):
+    """raft_tpu/12 persists (storage, residency); load restores the
+    placement without re-deciding — a device-resident store comes back
+    resident, a cold one cold."""
+    X, Q = corpus
+    path = str(tmp_path / "layout.idx")
+    m = _wrap(sealed, X, "tiered", "layout")
+    assert m.tiered_store.promote(force=True)
+    stream_save(m, path)
+    rec = stream_load(path, search_params=SP, tier=POLICY)
+    assert rec.storage == "tiered"
+    assert rec.tiered_store.mirror_resident, (
+        "saved device residency must restore without re-deciding")
+    # the restore threads into CONSTRUCTION (one placement event, no
+    # re-decide-then-correct upload/spill churn)
+    events = rec.tiered_store.stats()["events"]
+    assert [e["event"] for e in events] == ["promote"], events
+    assert events[0]["reason"] == "placement"
+    _assert_bit_equal(m.search_refined(Q, 10, 4),
+                      rec.search_refined(Q, 10, 4), "reloaded refined")
+
+    m.tiered_store.spill()
+    stream_save(m, path)
+    # a cold-saved store restores cold even when a roomy budget would
+    # have decided "device" — the layout is restored, never re-decided
+    # (and with zero residency events: no upload-then-spill churn)
+    roomy = default_resources()
+    prev = roomy.memory_budget_bytes
+    roomy.memory_budget_bytes = (obs_mem.totals()["device_bytes"]
+                                 + 4 * m.tiered_store.row_bytes)
+    try:
+        rec2 = stream_load(path, search_params=SP, tier=POLICY)
+    finally:
+        roomy.memory_budget_bytes = prev
+    assert not rec2.tiered_store.mirror_resident
+    assert rec2.tiered_store.stats()["events"] == []
+
+
+def test_serialize_11_read_compat_both_directions(sealed, corpus, tmp_path,
+                                                  monkeypatch):
+    """Both directions of the /11 compat contract: (a) bytes written by a
+    writer PINNED to raft_tpu/11 (the old layout, no tier fields) load in
+    this build as storage="hbm"; (b) this build's /12 bytes carry the
+    layout and load back tiered. The sealed ivf_pq payload is unchanged
+    either way."""
+    X, Q = corpus
+    old_path = str(tmp_path / "v11.idx")
+    m = _wrap(sealed, X, "hbm", "compat")
+    monkeypatch.setattr(serialize, "SERIALIZATION_VERSION", "raft_tpu/11")
+    stream_save(m, old_path)
+    monkeypatch.undo()
+    assert serialize.version_number(serialize.SERIALIZATION_VERSION) >= 12
+    rec = stream_load(old_path, search_params=SP)
+    assert rec.storage == "hbm" and rec.tiered_store is None
+    _assert_bit_equal(m.search(Q, 10), rec.search(Q, 10), "/11 search")
+
+    new_path = str(tmp_path / "v12.idx")
+    t = _wrap(sealed, X, "tiered", "compat12")
+    stream_save(t, new_path)
+    rec12 = stream_load(new_path, search_params=SP, tier=POLICY)
+    assert rec12.storage == "tiered"
+    _assert_bit_equal(t.search_refined(Q, 10, 4),
+                      rec12.search_refined(Q, 10, 4), "/12 refined")
+
+
+def test_plan_per_tier_contract(corpus):
+    """plan(storage="tiered") prices per tier: device = the scan
+    structures (the unchanged index_bytes figure), host/disk = the raw
+    rows EXACTLY (rows x dim x B — measured-ledger equality, well inside
+    the ±20% contract); hbm plans carry zeroed cold tiers."""
+    X, _ = corpus
+    p = obs_mem.plan("ivf_pq", PARAMS, N, D, storage="tiered")
+    assert p["tiers"]["device"] == p["index_bytes"]
+    assert p["tiers"]["host"] == N * D * 4 and p["tiers"]["disk"] == 0
+    ts = TieredStore(X, name="plan_probe")
+    entry = [r for r in obs_mem.breakdown() if r["component"] == "tier"
+             and r["name"] == "plan_probe"][0]
+    assert entry["host_bytes"] == p["tiers"]["host"], (
+        "host tier estimate must match the measured ledger exactly")
+    pd = obs_mem.plan("ivf_pq", PARAMS, N, D, storage="tiered",
+                      tier=TierPolicy(disk_path="/tmp/x"))
+    assert pd["tiers"]["disk"] == N * D * 4 and pd["tiers"]["host"] == 0
+    ph = obs_mem.plan("ivf_pq", PARAMS, N, D)
+    assert ph["tiers"] == {"device": ph["index_bytes"], "host": 0, "disk": 0}
+    pb = obs_mem.plan("brute_force", None, 1000, 32, dtype="int8",
+                      storage="tiered")
+    assert pb["tiers"]["host"] == 1000 * 32
+
+
+def test_host_budget_gate(corpus):
+    """Resources.host_budget_bytes refuses a RAM-resident store that
+    would blow the host budget (whole-or-nothing, the OverloadedError
+    taxonomy), while a disk-backed store prices nothing against it."""
+    X, _ = corpus
+    used_h = obs_mem.totals()["host_bytes"]
+    res = Resources(host_budget_bytes=used_h + X.nbytes // 2)
+    with pytest.raises(MemoryBudgetError) as ei:
+        TieredStore(X, name="hb_refused", res=res)
+    assert ei.value.site == "tier/host"
+    # same budget, disk-backed: admitted (pages are disk-backed)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ts = TieredStore(X, name="hb_disk", res=res,
+                         policy=TierPolicy(disk_path=f"{td}/cold"))
+        assert ts.residency == "disk"
+
+
+def test_debug_mem_tiers_section(sealed, corpus):
+    """/debug/mem carries the tiers section: per-store residency, tier
+    bytes and the spill/promote event trail."""
+    X, _ = corpus
+    m = _wrap(sealed, X, "tiered", "dbg")
+    ts = m.tiered_store
+    ts.promote(force=True)
+    ts.spill()
+    payload = obs_mem.debug_payload()
+    assert "tiers" in payload
+    mine = [s for s in payload["tiers"]["stores"] if s["name"] == "dbg"]
+    assert mine and mine[0]["residency"] == "host"
+    kinds = [e["event"] for e in mine[0]["events"]]
+    assert "promote" in kinds and "spill" in kinds
+    assert payload["tiers"]["totals"].get("host", 0) >= X.nbytes
+
+
+@pytest.mark.slow
+def test_tiered_parity_1m():
+    """1M-row twin of the parity test (slow manifest): the chunked oracle
+    walks 100+ real chunks and refined parity holds at scale."""
+    rng = np.random.default_rng(0)
+    n, d = 1_000_000, 16
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    Q = rng.standard_normal((64, d)).astype(np.float32)
+    p = ivf_pq.IndexParams(n_lists=1024, pq_bits=4, pq_dim=8, seed=0)
+    idx = ivf_pq.build(p, X)
+    a = MutableIndex(idx, search_params=SP, index_params=p, dataset=X,
+                     name="m1_hbm")
+    b = MutableIndex(idx, search_params=SP, index_params=p, dataset=X,
+                     storage="tiered", name="m1_tier",
+                     tier=TierPolicy(oracle_chunk=8192, auto_promote=False))
+    assert b.tiered_store.n_oracle_chunks() >= 100
+    _assert_bit_equal(a.search_refined(Q, 10, 4), b.search_refined(Q, 10, 4),
+                      "1m refined")
+    _assert_bit_equal(a.exact_search(Q, 10), b.exact_search(Q, 10),
+                      "1m oracle")
