@@ -2615,6 +2615,158 @@ def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
     })
 
 
+def _row_quant_funnel(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,
+                      m=1024, bucket=256, waves=3, ncl=2000, repeats=2):
+    """Quantization-funnel capacity A/B (ISSUE 16 acceptance): the SAME
+    clustered corpus built twice with identical codec parameters — classic
+    PQ (``fast_scan="none"``) vs the funnel twin carrying the bit-packed
+    1-bit signature tier — then swept over ``tune.funnel_grid`` so the
+    recall-vs-QPS-vs-bytes frontier lands in a decision log. The grid HEAD
+    is the classic operating point, so ``recall_target="default"`` anchors
+    the funnel pin to the classic scan's recall. Acceptance bits ride in
+    the row body (a violation converts to an error row):
+
+    - **width-1 bit-equality**: the funnel twin searched at
+      ``funnel_widen=1`` routes through the untouched classic scan and
+      answers bit-equal to the classic twin (same seed → same codebooks;
+      the signature tier is pure addition);
+    - **recall anchor holds**: the chosen funnel point's measured recall
+      on the held-out query set stays within tolerance of the classic
+      anchor (the sweep's choice rule enforces it on the sweep set);
+    - **capacity claim**: the funnel's hot-scan bytes per probed row
+      (packed signatures + ids, streamed by stage A) price ≥2× more rows
+      per HBM byte than the classic scan (unpacked PQ codes + ids) —
+      ``bytes_per_row``/``rows_per_hbm_byte`` are the fields
+      ``bench/compare.py`` gates on presence;
+    - **zero cold compiles** across the measured waves of both twins
+      (rehearsal wave first — the documented warm protocol).
+    """
+    import jax
+    import numpy as np
+
+    from raft_tpu import tune
+    from raft_tpu.neighbors import ivf_pq
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.tune.apply import search_fn
+
+    _note("quant: dataset")
+    dataset, qsets = _make_clustered(n, d, m, ncl, n_qsets=2, seed=13)
+    jax.block_until_ready([dataset] + qsets)
+    _note("quant: ground truth")
+    gt = _ground_truth(dataset, qsets[-1][:1000], k=k)
+    pools = [np.asarray(q) for q in qsets]
+
+    base = dict(n_lists=n_lists, pq_bits=4, pq_dim=pq_dim, seed=0)
+    _note("quant: classic build")
+    t0 = time.perf_counter()
+    idx_c = ivf_pq.build(ivf_pq.IndexParams(**base), dataset)
+    jax.block_until_ready(idx_c.list_codes)
+    build_c = time.perf_counter() - t0
+    _note("quant: funnel build (1bit tier)")
+    t0 = time.perf_counter()
+    idx_f = ivf_pq.build(ivf_pq.IndexParams(fast_scan="1bit", **base),
+                         dataset)
+    jax.block_until_ready(idx_f.list_sig)
+    build_f = time.perf_counter() - t0
+
+    # width-1 bit-equality: widen=1 routes through the classic scan on the
+    # same codebooks, so the tier must not change a single answer
+    _, ids_f1 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=8, funnel_widen=1), idx_f,
+        pools[0][:bucket], k)
+    _, ids_c1 = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=8), idx_c, pools[0][:bucket], k)
+    assert (np.asarray(ids_f1) == np.asarray(ids_c1)).all(), (
+        "funnel twin at funnel_widen=1 must answer bit-equal to the "
+        "classic-PQ twin")
+
+    _note("quant: funnel_grid sweep")
+    log = tune.DecisionLog()
+    dec = tune.sweep(idx_f, qsets[0], k=k, dataset=dataset, gt=None,
+                     grid=tune.funnel_grid(), recall_target="default",
+                     repeats=repeats, log=log)
+    ev = dec.evidence
+
+    fn_funnel = search_fn(idx_f, dec, dataset=dataset)
+    # the classic anchor serves the grid head's operating point on the
+    # no-tier twin — the honest bytes/QPS baseline
+    fn_classic = search_fn(
+        idx_c, {"n_probes": 8, "refine_ratio": 4}, dataset=dataset)
+
+    def run_waves(fn, label):
+        """Rehearse the (bucket, k) shape once, then measure ``waves``
+        full passes with compile attribution over the measured window."""
+        jax.block_until_ready(fn(pools[0][:bucket], k)[0])
+        walls, outs = [], None
+        with obs_compile.attribution() as rec:
+            for w in range(waves):
+                pool = pools[w % len(pools)]
+                wave_out = []
+                t0 = time.perf_counter()
+                for off in range(0, m, bucket):
+                    _, ids = fn(pool[off:off + bucket], k)
+                    wave_out.append(np.asarray(ids))
+                walls.append(time.perf_counter() - t0)
+                if w % len(pools) == len(pools) - 1:
+                    outs = np.concatenate(wave_out)
+        _note(f"quant: {label} waves done")
+        return walls, outs, rec
+
+    walls_c, out_c, rec_c = run_waves(fn_classic, "classic")
+    walls_f, out_f, rec_f = run_waves(fn_funnel, "funnel")
+    assert rec_c.compile_s == 0.0 and rec_c.cache_misses == 0, (
+        f"cold compile in the classic twin's measured waves: "
+        f"{rec_c.compile_s}s / {rec_c.cache_misses} misses")
+    assert rec_f.compile_s == 0.0 and rec_f.cache_misses == 0, (
+        f"cold compile in the funnel twin's measured waves: "
+        f"{rec_f.compile_s}s / {rec_f.cache_misses} misses")
+
+    recall_c = round(_recall(out_c[:1000], gt), 4)
+    recall_f = round(_recall(out_f[:1000], gt), 4)
+    assert recall_f >= recall_c - 0.02, (
+        f"funnel recall {recall_f} broke the classic anchor {recall_c} "
+        "on the held-out set")
+
+    # hot-scan bytes per probed row: what stage A streams per candidate.
+    # Classic scans the unpacked PQ codes + ids; the funnel scans the
+    # packed signatures + ids and touches codes only for the k_widen
+    # survivors (gather, not stream).
+    bpr_c = int(idx_c.list_codes.shape[2]) + 4
+    bpr_f = int(idx_f.list_sig.shape[2]) + 4
+    capacity_x = bpr_c / bpr_f
+    assert capacity_x >= 2.0, (
+        f"funnel must price >=2x rows per HBM byte, got {capacity_x:.2f} "
+        f"(classic {bpr_c} B/row vs funnel {bpr_f} B/row)")
+
+    qps_c = round(m * waves / sum(walls_c), 1)
+    qps_f = round(m * waves / sum(walls_f), 1)
+    rows.append({
+        "name": "quant_funnel_100k", "n": n, "k": k,
+        "qps": qps_f,
+        "qps_classic": qps_c,
+        "recall": recall_f,           # gated by compare.py
+        "recall_classic": recall_c,   # the anchor, gated too
+        "bytes_per_row": bpr_f,       # presence-gated by compare.py
+        "rows_per_hbm_byte": round(1.0 / bpr_f, 6),
+        "bytes_per_row_classic": bpr_c,
+        "rows_per_hbm_byte_classic": round(1.0 / bpr_c, 6),
+        "capacity_x": round(capacity_x, 3),
+        "build_s": round(build_f, 1),
+        "build_classic_s": round(build_c, 1),
+        "decision": dec.key, "chosen": dict(dec.params),
+        "n_trials": len(ev["trials"]),
+        "frontier": ev["frontier"],
+        "chosen_qps_over_default": ev["chosen_qps_over_default"],
+        "steady_compile_s": rec_f.compile_s,
+        "steady_cache_misses": rec_f.cache_misses,
+        "quant_note": "same corpus, same codec seed: funnel twin bit-equal "
+                      "to classic at width 1, recall anchored to the "
+                      "classic operating point by the funnel_grid head, "
+                      "capacity_x is hot-scan bytes/row priced classic "
+                      "over funnel, frontier recorded in the decision log",
+    })
+
+
 def _row_ivf_flat(rows, dataset, qsets, gt):
     import numpy as np
 
@@ -2887,6 +3039,11 @@ def _run(rows):
         _row_guard(rows, "tiered_100k", lambda: _row_tiered(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "quant_funnel_100k",
+                   lambda: _row_quant_funnel(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -3007,6 +3164,14 @@ def main(argv=None):
             # tiered A/B under a squeezing device budget
             _setup(rows)
             _row_guard(rows, "tiered_100k", lambda: _row_tiered(rows))
+        elif "--quant" in argv:
+            # quantization-funnel loop only (ISSUE 16): the iteration path
+            # for fast-scan / funnel-width / rotation parameters — the
+            # classic-PQ vs funnel-twin capacity A/B with the funnel_grid
+            # sweep; the heavy 1M OPQ sweep is the slow-manifest test
+            _setup(rows)
+            _row_guard(rows, "quant_funnel_100k",
+                       lambda: _row_quant_funnel(rows))
         elif "--tune-smoke" in argv:
             # autotune loop proof only (ISSUE 7): the quick iteration
             # path for the tune sweep engine; heavy sweeps are

@@ -19,7 +19,9 @@ is a QPS/recall field present in the old row but missing from the new —
 a lost measurement must not pass as "ok". The per-tier ``mem.tiers.*``
 sub-fields (rows served through a TieredStore) gate the same way on
 PRESENCE: byte levels shift legitimately between runs, but a tier
-measurement the old artifact had and the new lost fails the gate.
+measurement the old artifact had and the new lost fails the gate. The
+quantization-funnel capacity fields (``bytes_per_row``,
+``rows_per_hbm_byte``) follow the same presence rule.
 
 Accepts both the committed driver wrapper (``{n, cmd, rc, tail, parsed}``)
 and a bare bench snapshot (``{metric, value, rows, ...}``); an artifact
@@ -61,6 +63,18 @@ def _tier_keys(row: dict):
         row.get("mem"), dict) else {}
     return sorted(k for k, v in tiers.items()
                   if isinstance(v, (int, float)))
+
+
+# capacity fields of the quantization-funnel rows (quant_funnel_100k and
+# friends): gated on PRESENCE, like the per-tier mem sub-fields — the
+# measured bytes shift with codec parameters, but a run that LOSES the
+# capacity measurement must fail the gate, not pass as "ok"
+_CAPACITY_FIELDS = ("bytes_per_row", "rows_per_hbm_byte")
+
+
+def _capacity_keys(row: dict):
+    return [k for k in _CAPACITY_FIELDS
+            if isinstance(row.get(k), (int, float))]
 
 
 def _tier_get(row: dict, key: str):
@@ -125,6 +139,15 @@ def compare(old: dict, new: dict, *, qps_tol: float = 0.15,
                 check["regression"] = True
                 row["status"] = "regression"
             row["checks"].append(check)
+        for key in _capacity_keys(o):
+            if not isinstance(n.get(key), (int, float)):
+                row["status"] = "regression"
+                row["checks"].append({"field": key, "old": o[key],
+                                      "new": None, "missing": True,
+                                      "regression": True})
+            else:
+                row["checks"].append({"field": key, "old": o[key],
+                                      "new": n[key]})
         for key in _tier_keys(o):
             got = _tier_get(n, key)
             if not isinstance(got, (int, float)):

@@ -183,6 +183,9 @@ def _balanced_em_minibatch(x, init_centers, key, k: int, n_iters: int,
 
     def body(i, carry):
         centers, ccounts, key = carry
+        # rotating batches of one up-front shuffle — the discipline ivf_pq's
+        # OPQ rotation trainer (_train_opq_rotation) borrows for its
+        # alternating codebook-fit / Procrustes rounds
         idx = perm[(i * batch + offs) % n]
         xb = jnp.take(x, idx, axis=0)
         xbf = xb.astype(jnp.float32)

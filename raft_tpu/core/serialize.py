@@ -69,7 +69,14 @@ __all__ = [
 #       (raft_tpu.stream.tiered), so load() restores placement without
 #       re-deciding; /11 files read back as storage="hbm". Every other
 #       section is unchanged from /11.
-SERIALIZATION_VERSION = "raft_tpu/12"
+#   raft_tpu/13: ivf_pq carries the quantization-codec record (trailing,
+#       after tuned): rotation_kind ("none"/"opq" — the learned rotation is
+#       already folded into the serialized rotation matrix), codebook_loss
+#       ("l2"/"anisotropic"), fast_scan ("none"/"1bit"/"4bit") + the packed
+#       signature tier (list_sig, sig_scales). /12 files read back with the
+#       codec defaults (no rotation record, l2 loss, no fast-scan tier);
+#       every other section is unchanged from /12.
+SERIALIZATION_VERSION = "raft_tpu/13"
 
 # Older versions each tag can still READ (ivf_pq's and cagra's layouts
 # changed in raft_tpu/6, ivf_flat's in /5 — bumping the global version
@@ -80,20 +87,21 @@ _READ_COMPATIBLE: dict[str, frozenset[str]] = {
     "ivf_flat": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                            "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
                            "raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
-                           "raft_tpu/11"}),
+                           "raft_tpu/11", "raft_tpu/12"}),
     "ivf_pq": frozenset({"raft_tpu/3", "raft_tpu/4", "raft_tpu/5",
                          "raft_tpu/6", "raft_tpu/7", "raft_tpu/8",
-                         "raft_tpu/9", "raft_tpu/10", "raft_tpu/11"}),
+                         "raft_tpu/9", "raft_tpu/10", "raft_tpu/11",
+                         "raft_tpu/12"}),
     "cagra": frozenset({"raft_tpu/2", "raft_tpu/3", "raft_tpu/4",
                         "raft_tpu/5", "raft_tpu/6", "raft_tpu/7",
                         "raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
-                        "raft_tpu/11"}),
+                        "raft_tpu/11", "raft_tpu/12"}),
     "stream": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
-                         "raft_tpu/11"}),
+                         "raft_tpu/11", "raft_tpu/12"}),
     "brute_force": frozenset({"raft_tpu/8", "raft_tpu/9", "raft_tpu/10",
-                              "raft_tpu/11"}),
+                              "raft_tpu/11", "raft_tpu/12"}),
     # "mesh" is new in /11 — that is the oldest layout it accepts
-    "mesh": frozenset({"raft_tpu/11"}),
+    "mesh": frozenset({"raft_tpu/11", "raft_tpu/12"}),
 }
 
 

@@ -16,7 +16,8 @@ from ..distance.fused_nn import _fused_l2_nn
 from ..distance.types import DistanceType
 
 __all__ = ["round_up", "list_positions", "plan_search_tiles", "assign_to_lists",
-           "split_oversized", "spatial_split_key", "bound_capacity"]
+           "split_oversized", "spatial_split_key", "bound_capacity",
+           "pq_scan_bytes_per_probe_row", "funnel_scan_bytes_per_probe_row"]
 
 
 def round_up(x: int, mult: int) -> int:
@@ -208,6 +209,16 @@ def pq_scan_bytes_per_probe_row(capacity: int, pq_dim: int, n_codes: int) -> int
     x2 for XLA temporaries (the gather and its consumer co-exist) —
     undercounting here OOMed the device at 1M scale."""
     return 2 * (capacity * pq_dim * 9 + pq_dim * n_codes * 8)
+
+
+def funnel_scan_bytes_per_probe_row(capacity: int, sig_words: int) -> int:
+    """Memory model for one (query, probe) pair of the fast-scan funnel's
+    binary tier (ivf_pq fast_scan): packed signature gather (uint8) +
+    estimator scores (f32) per capacity slot, plus the 32-entry nibble LUT;
+    same x2 temporaries convention as :func:`pq_scan_bytes_per_probe_row`.
+    The PQ rerank that follows touches only the k_widen survivors, so the
+    binary tier dominates the per-probe footprint."""
+    return 2 * (capacity * (sig_words * 9 + 4) + sig_words * 32 * 8)
 
 
 def plan_search_tiles(m: int, n_probes: int, k: int, capacity: int,

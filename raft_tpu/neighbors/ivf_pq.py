@@ -40,21 +40,46 @@ from ..core import tracing
 from ..core.errors import expects
 from ..core.logger import logger
 from ..obs import mem as obs_mem
+from ..obs import metrics
 from ..obs.instrument import dtype_of, instrument, nrows
 from ..core.resources import Resources, default_resources
+from ..core import serialize as core_serialize
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
                               deserialize_tuned, serialize_header,
                               serialize_mdspan, serialize_scalar,
-                              serialize_tuned)
+                              serialize_tuned, version_number)
 from ..distance.pairwise import _choose_tile
 from ..distance.types import DistanceType, resolve_metric
 from ..matrix.select_k import _select_k, select_k_impl
 from ..random.rng import as_key
-from ._list_utils import (assign_to_lists, bound_capacity, list_positions,
+from ._list_utils import (assign_to_lists, bound_capacity,
+                          funnel_scan_bytes_per_probe_row, list_positions,
                           plan_search_tiles, pq_scan_bytes_per_probe_row,
                           round_up)
 
 __all__ = ["IndexParams", "SearchParams", "IvfPqIndex", "build", "extend", "search", "save", "load"]
+
+
+@functools.lru_cache(maxsize=None)
+def _quant_opq_seconds():
+    return metrics.histogram(
+        "raft_tpu_quant_opq_train_seconds",
+        "OPQ rotation training wall time per build", unit="s")
+
+
+@functools.lru_cache(maxsize=None)
+def _quant_funnel_total():
+    return metrics.counter(
+        "raft_tpu_quant_funnel_searches_total",
+        "searches routed through the fast-scan funnel (funnel_widen > 1)")
+
+
+@functools.lru_cache(maxsize=None)
+def _quant_bytes_per_row():
+    return metrics.gauge(
+        "raft_tpu_quant_scan_bytes_per_row",
+        "hot-scan HBM bytes per stored row, by tier (labels: tier)",
+        unit="bytes")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,6 +147,40 @@ class IndexParams:
     # ||r - s*decode||^2, ~zero scan-time cost (the fold is one multiply on
     # the per-probe LUT). Composes with either codebook_kind.
     residual_scale_norm: bool = False
+    # Learned rotation (quantization funnel stage a). "opq": alternate
+    # codebook-fit / orthogonal-Procrustes updates on rotating mini-batches
+    # (Ge et al., CVPR'13 — the same jitted mini-batch EM discipline as the
+    # coarse trainer's minibatch mode) and fold the learned R into the
+    # index rotation, so search pays nothing beyond the one rotation matmul
+    # it already does. "none": the reference behavior (identity/QR per
+    # force_random_rotation).
+    rotation: str = "none"
+    opq_rounds: int = 8  # alternations of fit-codebooks / Procrustes-update
+    opq_batch_rows: int = 16384  # rows per rotating OPQ mini-batch
+    # Codebook training loss (funnel stage b). "anisotropic": ScaNN-style
+    # score-aware weighting (Guo et al., ICML'20) — residual error PARALLEL
+    # to the datapoint direction costs eta x the orthogonal error, because
+    # parallel error is what perturbs inner-product scores near the top of
+    # a ranking. Codebook fit and encode assignment both use the weighted
+    # distance; search LUTs are untouched (scores stay exact for whatever
+    # code was assigned). Pays on IP workloads; needs a joint codebook
+    # (incompatible with the nibble-split pq8 trainer).
+    codebook_loss: str = "l2"
+    # anisotropic parallel/orthogonal weight; 0.0 = auto from the ScaNN
+    # threshold rule eta = (d_rot - 1) T^2 / (1 - T^2) at T = 0.2
+    anisotropic_eta: float = 0.0
+    # Fast-scan pre-filter tier (funnel stage c): per-row bit-packed
+    # signatures of the rotated residual scanned AHEAD of the PQ scan, so
+    # widen-then-refine becomes binary widen -> PQ rerank -> exact refine.
+    #   "1bit" — RaBitQ-style sign bits, ceil(d_rot/8) bytes/row: the hot
+    #            scan streams ~4x fewer HBM bytes than pq4 x (pq_dim=d)
+    #            unpacked codes.
+    #   "4bit" — per-dim 4-bit levels, ceil(d_rot/2) bytes/row: a finer
+    #            estimator at pq4-class bytes.
+    #   "none" — no tier; SearchParams.funnel_widen must stay 1.
+    # Estimated scores pre-filter only — survivors are re-scored exactly
+    # (PQ decode), so funnel results at sufficient widen match classic PQ.
+    fast_scan: str = "none"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -186,6 +245,14 @@ class SearchParams:
     # The coarse cluster select (k = n_probes, n_lists cols) always stays
     # on lax.top_k — never in the wide regime.
     select_impl: str = "auto"
+    # Quantization-funnel width (first-class tuned knob, like refine_ratio):
+    # > 1 routes search through the fast-scan tier — per probe chunk the
+    # bit-packed signatures are scanned first and only the best
+    # funnel_widen * k candidates reach the exact PQ decode-and-rerank; the
+    # candidate merges stay on the one select_k dispatch with the shared
+    # -1/±inf sentinel. Requires an index built with IndexParams.fast_scan.
+    # 1 (default) = the classic full PQ scan, bit-for-bit unchanged.
+    funnel_widen: int = 1
 
 
 @jax.tree_util.register_pytree_node_class
@@ -207,6 +274,14 @@ class IvfPqIndex:
     # _norm); (0,) = normalization disabled. Codes encode r/s_list; search
     # folds s_list back into the LUT, so scores stay exact ||r - s*decode||^2
     list_scales: jax.Array = None
+    # (n_lists, capacity, sig_words) uint8 bit-packed fast-scan signatures
+    # (IndexParams.fast_scan); (n_lists, 0, 0) = no tier. 1bit packs sign
+    # bits of the raw rotated residual 8/byte; 4bit packs per-dim levels
+    # 2/byte (lo nibble = even dim)
+    list_sig: jax.Array = None
+    # (n_lists,) f32 per-list signature decode scales (mean |r_j| for 1bit,
+    # per-dim RMS for 4bit); (0,) = no tier
+    sig_scales: jax.Array = None
     metric: DistanceType = DistanceType.L2Expanded
     codebook_kind: str = "per_subspace"
     pq_bits: int = 8
@@ -223,6 +298,13 @@ class IvfPqIndex:
     # way; data_kind governs what extend() accepts and how search()
     # coerces queries, so a byte index never silently mixes domains.
     data_kind: str = "float32"
+    # quantization-funnel codec provenance (raft_tpu/13 codec record):
+    # rotation_kind "none"|"opq"; codebook_loss "l2"|"anisotropic" (encode
+    # assignment re-derives the auto eta from d_rot); fast_scan
+    # "none"|"1bit"|"4bit" (must agree with the list_sig shape)
+    rotation_kind: str = "none"
+    codebook_loss: str = "l2"
+    fast_scan: str = "none"
     # pinned operating point (raft_tpu.tune decision dict; None = untuned):
     # consulted by batched_searcher when no explicit params are given,
     # persisted by save/load (raft_tpu/9). NOT part of the pytree (same
@@ -270,6 +352,11 @@ class IvfPqIndex:
             self.list_consts = jnp.zeros((self.list_codes.shape[0], 0), jnp.float32)
         if self.list_scales is None:
             self.list_scales = jnp.zeros((0,), jnp.float32)
+        if self.list_sig is None:
+            self.list_sig = jnp.zeros((self.list_codes.shape[0], 0, 0),
+                                      jnp.uint8)
+        if self.sig_scales is None:
+            self.sig_scales = jnp.zeros((0,), jnp.float32)
 
     @property
     def scale_normed(self) -> bool:
@@ -277,18 +364,32 @@ class IvfPqIndex:
         flag, so it stays concrete inside jit traces)."""
         return self.list_scales.shape[0] > 0
 
+    @property
+    def has_fast_scan(self) -> bool:
+        """True when the index carries a bit-packed fast-scan tier (shape-
+        level flag — the sig word width is static inside jit traces)."""
+        return self.list_sig.shape[-1] > 0
+
     def tree_flatten(self):
         children = (self.centers, self.centers_rot, self.rotation, self.codebooks,
                     self.list_codes, self.list_ids, self.list_sizes,
-                    self.list_consts, self.list_scales)
+                    self.list_consts, self.list_scales, self.list_sig,
+                    self.sig_scales)
         return children, (self.metric, self.codebook_kind, self.pq_bits,
-                          self.split_factor, self.pq_split, self.data_kind)
+                          self.split_factor, self.pq_split, self.data_kind,
+                          self.rotation_kind, self.codebook_loss,
+                          self.fast_scan)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         kind = aux[5] if len(aux) > 5 else "float32"
+        # pre-funnel pytrees (9 children, 6 aux) unflatten to a codec-free
+        # index — the same back-compat contract as data_kind above
+        extra = aux[6:9] if len(aux) > 8 else ("none", "l2", "none")
         return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2],
-                   split_factor=aux[3], pq_split=aux[4], data_kind=kind)
+                   split_factor=aux[3], pq_split=aux[4], data_kind=kind,
+                   rotation_kind=extra[0], codebook_loss=extra[1],
+                   fast_scan=extra[2])
 
 
 def _resolve_pq_ingest(x, mt: DistanceType):
@@ -511,6 +612,189 @@ def _per_list_residual_scales(resid, labels, n_lists: int):
     return jnp.sqrt(jnp.maximum(msq / d_rot, 1e-24))
 
 
+def _default_aniso_eta(d_rot: int, t: float = 0.2) -> float:
+    """ScaNN's threshold rule (Guo et al., ICML'20 §3.2): weight parallel
+    residual error eta = (d - 1) T^2 / (1 - T^2) at relative score
+    threshold T — errors along the datapoint direction perturb the
+    inner-product ranking ~eta times as much as orthogonal ones."""
+    return max((d_rot - 1) * t * t / (1.0 - t * t), 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_codes", "n_iters", "eta"))
+def _train_codebooks_aniso(subvecs, key, n_codes: int, n_iters: int,
+                           eta: float):
+    """Anisotropic weighted EM (IndexParams.codebook_loss="anisotropic"):
+    subvecs (B, n, pq_len) → codebooks (B, n_codes, pq_len), same batched
+    layout as :func:`_train_codebooks_batched`. Assignment minimizes
+    ||x - c||^2 + (eta - 1) <u, x - c>^2 with u = x/||x|| (parallel error
+    weighted eta x); the centroid update solves the per-codeword normal
+    equations (count I + (eta-1) Σ u u^T) c = eta Σ x — a (n_codes,
+    pq_len, pq_len) batched solve, tiny at PQ subvector widths."""
+
+    em1 = eta - 1.0
+
+    def one(sv, k):
+        n, L = sv.shape
+        norm = jnp.sqrt(jnp.maximum(jnp.sum(sv * sv, axis=1), 1e-30))
+        u = sv / norm[:, None]
+        init_idx = jax.random.choice(k, n, (n_codes,), replace=n < n_codes)
+        centers = jnp.take(sv, init_idx, axis=0)
+        eye = jnp.eye(L, dtype=jnp.float32)
+
+        def body(i, c):
+            d2 = jnp.sum(c * c, axis=1)[None, :] - 2.0 * sv @ c.T
+            # <u, x - c> = ||x|| - <u, c> (u is x's own direction)
+            upar = norm[:, None] - u @ c.T
+            labels = jnp.argmin(d2 + em1 * upar * upar, axis=1)
+            oh = jax.nn.one_hot(labels, n_codes, dtype=jnp.float32, axis=0)
+            counts = jnp.sum(oh, axis=1)
+            suu = jnp.einsum("kn,nl,nm->klm", oh, u, u)
+            a = counts[:, None, None] * eye[None] + em1 * suu
+            a = a + 1e-6 * eye[None]
+            b = eta * (oh @ sv)
+            sol = jnp.linalg.solve(a, b[..., None])[..., 0]
+            return jnp.where(counts[:, None] > 0, sol, c)
+
+        return lax.fori_loop(0, n_iters, body, centers)
+
+    keys = jax.random.split(key, subvecs.shape[0])
+    return jax.vmap(one)(subvecs.astype(jnp.float32), keys)
+
+
+@functools.partial(jax.jit, static_argnames=("pq_dim", "n_codes", "n_iters",
+                                             "rounds", "batch"))
+def _train_opq_rotation(resid_flat, key, pq_dim: int, n_codes: int,
+                        n_iters: int, rounds: int, batch: int):
+    """OPQ rotation (Ge et al., CVPR'13 Alg. 1), mini-batched: alternate
+    (1) fit per-subspace codebooks on a rotating batch of rotated residuals
+    (the same jitted mini-batch EM as the coarse trainer's minibatch mode),
+    (2) solve the orthogonal Procrustes problem min_R ||X R^T - Y||_F over
+    the batch's reconstructions Y (R = U V^T from SVD(Y^T X)). Returns the
+    (d_rot, d_rot) learned rotation to fold into the index rotation —
+    search pays nothing beyond the rotation matmul it already does."""
+    n, d_rot = resid_flat.shape
+    pq_len = d_rot // pq_dim
+    kp, kr = jax.random.split(key)
+    # one shuffle, then rounds walk it in rotating windows — every round
+    # sees fresh rows until the trainset wraps (the coarse minibatch-EM
+    # batching discipline)
+    perm = jax.random.permutation(kp, resid_flat.astype(jnp.float32))
+    rot = jnp.eye(d_rot, dtype=jnp.float32)
+    keys = jax.random.split(kr, rounds)
+    for i in range(rounds):
+        start = (i * batch) % max(n - batch + 1, 1)
+        xb = lax.dynamic_slice_in_dim(perm, start, batch, axis=0)
+        xr = xb @ rot.T
+        sub = jnp.moveaxis(xr.reshape(batch, pq_dim, pq_len), 1, 0)
+        cb = _train_codebooks_batched(sub, keys[i], n_codes, n_iters)
+        cb_n2 = jnp.sum(cb * cb, axis=-1)
+        dots = jnp.einsum("snl,skl->snk", sub, cb,
+                          precision=lax.Precision.HIGHEST)
+        code = jnp.argmin(cb_n2[:, None, :] - 2.0 * dots, axis=-1)
+        recon = jnp.take_along_axis(cb, code[..., None], axis=1)
+        y = jnp.moveaxis(recon, 0, 1).reshape(batch, d_rot)
+        u, _, vt = jnp.linalg.svd(y.T @ xb, full_matrices=True)
+        rot = u @ vt
+    return rot
+
+
+def _sig_words(d_rot: int, fast_scan: str) -> int:
+    """Packed signature bytes per row for a fast-scan mode."""
+    if fast_scan == "1bit":
+        return -(-d_rot // 8)
+    if fast_scan == "4bit":
+        return -(-d_rot // 2)
+    return 0
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists", "fast_scan"))
+def _per_list_sig_scales(resid_flat, labels, n_lists: int, fast_scan: str):
+    """(n_lists,) decode scale per list for the signature estimator, from
+    the RAW rotated training residuals: the least-squares fit of r ≈ s·σ
+    is s = mean|r_j| for ±1 signs (1bit); 4bit levels span ±2 per-dim RMS,
+    so s = sqrt(mean r_j^2). Lists the trainset missed fall back to the
+    global mean (same contract as _per_list_residual_scales); accumulation
+    is the same chunked one-hot matmul (no scatter-adds on TPU)."""
+    n, d_rot = resid_flat.shape
+    red = (jnp.sum(jnp.abs(resid_flat), axis=1) if fast_scan == "1bit"
+           else jnp.sum(resid_flat * resid_flat, axis=1))
+    blk = min(16384, max(round_up(n, 8), 8))
+    num = -(-n // blk)
+    rp = jnp.pad(red, (0, num * blk - n))
+    lp = jnp.pad(labels.astype(jnp.int32), (0, num * blk - n),
+                 constant_values=n_lists)
+
+    def body(args):
+        r, l = args
+        oh = jax.nn.one_hot(l, n_lists + 1, dtype=jnp.float32, axis=0)
+        return oh @ r, jnp.sum(oh, axis=1)
+
+    sums, counts = lax.map(body, (rp.reshape(num, blk), lp.reshape(num, blk)))
+    s = jnp.sum(sums, axis=0)[:n_lists]
+    c = jnp.sum(counts, axis=0)[:n_lists]
+    gmean = jnp.sum(red) / jnp.maximum(n, 1)
+    per_dim = jnp.where(c > 0, s / jnp.maximum(c, 1.0), gmean) / d_rot
+    per_dim = jnp.maximum(per_dim, 1e-24)
+    return per_dim if fast_scan == "1bit" else jnp.sqrt(per_dim)
+
+
+@functools.partial(jax.jit, static_argnames=("fast_scan",))
+def _encode_sig(resid_flat, scales, fast_scan: str):
+    """Bit-pack fast-scan signatures: resid_flat (n, d_rot) RAW rotated
+    residuals + per-row decode scales (n,) → (n, sig_words) uint8.
+    1bit: sign bits, dim 8w+b in bit b of byte w (scale-free). 4bit:
+    levels round((r/s)/step) clipped to [0, 15] around mid-level 7.5
+    (span ±2 RMS), even dim in the lo nibble. Padding dims pack as zero
+    bits — the query-side LUT zeroes their contribution."""
+    n, d_rot = resid_flat.shape
+    r = resid_flat.astype(jnp.float32)
+    if fast_scan == "1bit":
+        w = -(-d_rot // 8)
+        bits = (r > 0).astype(jnp.uint8)
+        bits = jnp.pad(bits, ((0, 0), (0, w * 8 - d_rot)))
+        weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+        return jnp.sum(bits.reshape(n, w, 8) * weights[None, None, :],
+                       axis=-1, dtype=jnp.uint8)
+    w = -(-d_rot // 2)
+    step = 4.0 / 15.0
+    lev = jnp.clip(jnp.round(r / (scales[:, None] * step) + 7.5), 0, 15)
+    lev = jnp.pad(lev, ((0, 0), (0, w * 2 - d_rot))).astype(jnp.uint8)
+    lo, hi = lev[:, 0::2], lev[:, 1::2]
+    return lo | (hi << 4)
+
+
+def _sig_nibble_lut(r, fast_scan: str, sig_words: int):
+    """Per-(query, probe) nibble LUT for the signature scan: r (..., d_rot)
+    raw rotated residuals → (..., sig_words, 32) where [..., :16] scores
+    the HI nibble of each packed byte and [..., 16:] the LO nibble — the
+    exact layout of the nibble-split one-hot contraction, so the fast-scan
+    tier reuses the pq8_split scan machinery unchanged. Entry value =
+    Σ_bits r_dim · level(bit), i.e. the contraction computes <r, σ> (1bit,
+    σ ∈ {-1,1}) or <r, lev> (4bit) exactly; padding dims contribute 0."""
+    d_rot = r.shape[-1]
+    if fast_scan == "1bit":
+        pad = sig_words * 8 - d_rot
+        # padded query dims are ZERO, so their ±1 level contributes 0 —
+        # padding needs no masking on either side
+        rp = jnp.pad(r, [(0, 0)] * (r.ndim - 1) + [(0, pad)])
+        r8 = rp.reshape(*r.shape[:-1], sig_words, 8)
+        v = jnp.arange(16, dtype=jnp.int32)
+        b = jnp.arange(4, dtype=jnp.int32)
+        pm = (2 * ((v[:, None] >> b[None, :]) & 1) - 1).astype(jnp.float32)
+        lut_lo = jnp.einsum("...wb,vb->...wv", r8[..., 0:4], pm)
+        lut_hi = jnp.einsum("...wb,vb->...wv", r8[..., 4:8], pm)
+        return jnp.concatenate([lut_hi, lut_lo], axis=-1)
+    # 4bit: byte w covers dims 2w (lo nibble) and 2w+1 (hi nibble)
+    pad = sig_words * 2 - d_rot
+    rp = jnp.pad(r, [(0, 0)] * (r.ndim - 1) + [(0, pad)])
+    r2 = rp.reshape(*r.shape[:-1], sig_words, 2)
+    step = 4.0 / 15.0
+    levels = (jnp.arange(16, dtype=jnp.float32) - 7.5) * step  # (16,)
+    lut_lo = r2[..., 0:1] * levels
+    lut_hi = r2[..., 1:2] * levels
+    return jnp.concatenate([lut_hi, lut_lo], axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("per_cluster",))
 def _pq_cross_consts(codes, codebooks, labels, per_cluster: bool):
     """Per-vector scan constant for split L2 scoring: sum_s 2*cb1[s,hi_s]·
@@ -544,15 +828,19 @@ def _pq_cross_consts(codes, codebooks, labels, per_cluster: bool):
     return out.reshape(num * blk)[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("per_cluster", "tile"))
-def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
+@functools.partial(jax.jit, static_argnames=("per_cluster", "tile",
+                                             "aniso_eta"))
+def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int,
+            aniso_eta: float = 0.0):
     """Nearest codebook entry per subspace, as tiled GEMMs.
 
     residuals_rot: (n, pq_dim, pq_len). codebooks: (pq_dim, K, L) for
     per_subspace, (n_lists, K, L) for per_cluster (selected via labels).
     Computes argmin over ‖r‖²-free scores ‖c‖² - 2·r·c (the search-LUT
     expansion) in row tiles so the (tile, pq_dim, K) block bounds memory.
-    Returns (n, pq_dim) uint8.
+    ``aniso_eta > 0`` switches to the score-aware anisotropic assignment
+    (codebook_loss="anisotropic"): + (eta-1)·<u, r-c>² with u = r/‖r‖,
+    matching the training loss. Returns (n, pq_dim) uint8.
     """
     n = residuals_rot.shape[0]
     cb = codebooks.astype(jnp.float32)
@@ -573,6 +861,18 @@ def _encode(residuals_rot, codebooks, labels, per_cluster: bool, tile: int):
         else:
             dots = jnp.einsum("tsl,skl->tsk", rb, cb, precision=lax.Precision.HIGHEST)
             d2 = cb_n2[None] - 2.0 * dots
+        if aniso_eta > 0.0:
+            nrm = jnp.sqrt(jnp.maximum(jnp.sum(rb * rb, axis=-1), 1e-30))
+            u = rb / nrm[..., None]  # (t, pq_dim, L)
+            if per_cluster:
+                ucb = jnp.einsum("tsl,tkl->tsk", u, cbl,
+                                 precision=lax.Precision.HIGHEST)
+            else:
+                ucb = jnp.einsum("tsl,skl->tsk", u, cb,
+                                 precision=lax.Precision.HIGHEST)
+            # <u, r - c> = ‖r‖ - <u, c>; the ‖r‖²-free d2 gains the full
+            # parallel-error surcharge (the dropped ‖r‖² is code-constant)
+            d2 = d2 + (aniso_eta - 1.0) * (nrm[..., None] - ucb) ** 2
         return jnp.argmin(d2, axis=-1).astype(jnp.uint8)
 
     codes = lax.map(body, (rt, lt))
@@ -608,8 +908,11 @@ def _select_scores(codes, lut, split: bool):
     return jnp.sum(acc, axis=-1)
 
 
-def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int, consts=None):
-    """Scatter codes into padded lists (shared ivf::list scheme)."""
+def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int,
+                     consts=None, sig=None):
+    """Scatter codes into padded lists (shared ivf::list scheme). ``sig``
+    (n, sig_words) scatters the fast-scan tier alongside the codes so the
+    two layouts can never disagree on slot positions."""
     n, pq_dim = codes.shape
     pos, counts = list_positions(labels, n_lists)
     buf = jnp.zeros((n_lists, capacity, pq_dim), jnp.uint8)
@@ -620,7 +923,12 @@ def _fill_code_lists(codes, ids, labels, n_lists: int, capacity: int, consts=Non
         cbuf = jnp.zeros((n_lists, 0), jnp.float32)
     else:
         cbuf = jnp.zeros((n_lists, capacity), jnp.float32).at[labels, pos].set(consts)
-    return buf, idbuf, counts.astype(jnp.int32), cbuf
+    if sig is None:
+        sbuf = jnp.zeros((n_lists, 0, 0), jnp.uint8)
+    else:
+        sbuf = jnp.zeros((n_lists, capacity, sig.shape[1]), jnp.uint8
+                         ).at[labels, pos].set(sig)
+    return buf, idbuf, counts.astype(jnp.int32), cbuf, sbuf
 
 
 @instrument("ivf_pq.build",
@@ -647,6 +955,14 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     )
     expects(params.codebook_kind in ("per_subspace", "per_cluster", "auto"),
             "codebook_kind must be per_subspace|per_cluster|auto")
+    expects(params.rotation in ("none", "opq"),
+            "rotation must be 'none' or 'opq', got %r", params.rotation)
+    expects(params.codebook_loss in ("l2", "anisotropic"),
+            "codebook_loss must be 'l2' or 'anisotropic', got %r",
+            params.codebook_loss)
+    expects(params.fast_scan in ("none", "1bit", "4bit"),
+            "fast_scan must be 'none', '1bit' or '4bit', got %r",
+            params.fast_scan)
 
     data_kind, x = _resolve_pq_ingest(x, mt)
     # memory-budget admission (no-op unless res.memory_budget_bytes is
@@ -706,15 +1022,52 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
                                                     params.n_lists)
         resid = resid / jnp.take(list_scales, labels)[:, None, None]
 
+    # 3b. learned rotation (funnel stage a): alternate codebook-fit /
+    # Procrustes on rotating mini-batches of the (unit-scale) residual
+    # trainset, then FOLD the learned R into the index rotation — the
+    # query transform is still one matmul, and the per-list scales stay
+    # valid (orthogonal R preserves residual norms)
+    if params.rotation == "opq":
+        import time as _time
+
+        key, ko = jax.random.split(key)
+        split_pref_ = (params.pq8_split if params.pq8_split is not None
+                       else mt != DistanceType.InnerProduct)
+        opq_codes = 16 if (params.pq_bits == 8 and split_pref_) else n_codes
+        batch = min(int(params.opq_batch_rows), n_train)
+        t0 = _time.perf_counter()
+        with tracing.range("ivf_pq.build.opq"):
+            r_opq = _train_opq_rotation(
+                resid.reshape(n_train, d_rot), ko, pq_dim, opq_codes,
+                min(params.kmeans_n_iters, 10), int(params.opq_rounds),
+                batch)
+            r_opq = jax.block_until_ready(r_opq)
+        if metrics.enabled():
+            _quant_opq_seconds().observe(_time.perf_counter() - t0)
+        rotation = r_opq @ rotation
+        centers_rot = centers @ rotation.T
+        resid = (resid.reshape(n_train, d_rot) @ r_opq.T
+                 ).reshape(n_train, pq_dim, pq_len)
+
     # 4. codebooks (ref train_per_subset :343 / train_per_cluster :424)
     key, kc = jax.random.split(key)
     split_pref = (params.pq8_split if params.pq8_split is not None
                   else mt != DistanceType.InnerProduct)
     split = params.pq_bits == 8 and split_pref
+    aniso_eta = 0.0
+    if params.codebook_loss == "anisotropic":
+        expects(not split, "codebook_loss='anisotropic' needs a joint "
+                "codebook — nibble-split pq8 trains a two-stage residual "
+                "quantizer (set pq8_split=False or pq_bits < 8)")
+        aniso_eta = float(params.anisotropic_eta
+                          or _default_aniso_eta(d_rot))
 
     def train(pools):
         if split:
             return _train_split_codebooks(pools, kc, params.kmeans_n_iters)
+        if aniso_eta > 0.0:
+            return _train_codebooks_aniso(pools, kc, n_codes,
+                                          params.kmeans_n_iters, aniso_eta)
         return _train_codebooks_batched(pools, kc, n_codes, params.kmeans_n_iters)
 
     kind = params.codebook_kind
@@ -763,6 +1116,19 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         with tracing.range("ivf_pq.build.train_codebooks"):
             codebooks = train(pools)
 
+    # 5. fast-scan tier decode scales (funnel stage c): fit per-list from
+    # the RAW rotated residuals (signatures are scale-norm-independent —
+    # restoring s_list here keeps the estimator exact either way)
+    sig_scales = jnp.zeros((0,), jnp.float32)
+    sig_w = _sig_words(d_rot, params.fast_scan)
+    if params.fast_scan != "none":
+        raw = resid.reshape(n_train, d_rot)
+        if params.residual_scale_norm:
+            raw = raw * jnp.take(list_scales, labels)[:, None]
+        with tracing.range("ivf_pq.build.sig_scales"):
+            sig_scales = _per_list_sig_scales(raw, labels, params.n_lists,
+                                              params.fast_scan)
+
     index = IvfPqIndex(
         centers=centers,
         centers_rot=centers_rot,
@@ -772,12 +1138,17 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
         list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
         list_scales=list_scales,
+        list_sig=jnp.zeros((params.n_lists, 0, sig_w), jnp.uint8),
+        sig_scales=sig_scales,
         metric=mt,
         codebook_kind=kind,
         pq_bits=params.pq_bits,
         split_factor=params.split_factor,
         pq_split=split,
         data_kind=data_kind,
+        rotation_kind=params.rotation,
+        codebook_loss=params.codebook_loss,
+        fast_scan=params.fast_scan,
     )
     if not params.add_data_on_build:
         obs_mem.account_index(index)
@@ -870,6 +1241,12 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     with tracing.range("ivf_pq.extend.assign"):
         labels = assign_to_lists(x, index.centers, index.metric, tile)
     resid = (x.astype(jnp.float32) - jnp.take(index.centers, labels, axis=0)) @ index.rotation.T
+    sig = None
+    if index.has_fast_scan:
+        # signatures pack the RAW rotated residual (scale-norm independent)
+        with tracing.range("ivf_pq.extend.encode_sig"):
+            sig = _encode_sig(resid, jnp.take(index.sig_scales, labels),
+                              index.fast_scan)
     resid = resid.reshape(n_new, index.pq_dim, index.pq_len)
     if index.scale_normed:
         # codes encode UNIT-scale residuals; search re-applies s_list in the
@@ -887,6 +1264,8 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
             resid, enc_cb, labels,
             per_cluster=per_cluster,
             tile=min(enc_tile, 8192),
+            aniso_eta=(_default_aniso_eta(index.rot_dim)
+                       if index.codebook_loss == "anisotropic" else 0.0),
         )
     consts = None
     if index.pq_split and index.metric != DistanceType.InnerProduct:
@@ -910,6 +1289,9 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
         if consts is not None:
             old_consts = index.list_consts.reshape(-1)[old_mask]
             consts = jnp.concatenate([old_consts, consts])
+        if sig is not None:
+            old_sig = index.list_sig.reshape(-1, index.list_sig.shape[2])[old_mask]
+            sig = jnp.concatenate([old_sig, sig])
 
     import numpy as np
 
@@ -920,7 +1302,7 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     sf = index.split_factor if split_factor is None else split_factor
     labels, rep, n_lists, capacity, _ = bound_capacity(labels, index.n_lists, sf)
     centers, centers_rot, codebooks = index.centers, index.centers_rot, index.codebooks
-    list_scales = index.list_scales
+    list_scales, sig_scales = index.list_scales, index.sig_scales
     if rep is not None:
         centers = jnp.asarray(np.repeat(np.asarray(centers), rep, axis=0))
         centers_rot = jnp.asarray(np.repeat(np.asarray(centers_rot), rep, axis=0))
@@ -931,18 +1313,28 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
             # (codes were encoded against both)
             list_scales = jnp.asarray(
                 np.repeat(np.asarray(list_scales), rep, axis=0))
+        if index.has_fast_scan:
+            # ... and its signature decode scale, for the same reason
+            sig_scales = jnp.asarray(
+                np.repeat(np.asarray(sig_scales), rep, axis=0))
     with tracing.range("ivf_pq.extend.fill_lists"):
-        buf, idbuf, sizes, cbuf = _fill_code_lists(
-            codes, new_ids, labels, n_lists, capacity, consts)
+        buf, idbuf, sizes, cbuf, sbuf = _fill_code_lists(
+            codes, new_ids, labels, n_lists, capacity, consts, sig)
     out = dataclasses.replace(
         index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
         list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
-        list_scales=list_scales, split_factor=sf,
+        list_scales=list_scales, list_sig=sbuf, sig_scales=sig_scales,
+        split_factor=sf,
     )
     # ledger hook (docs/observability.md): the re-packed lists are the
     # long-lived allocation; a superseded index's entry auto-releases
     # when its last reference drops
     obs_mem.account_index(out)
+    if metrics.enabled():
+        g = _quant_bytes_per_row()
+        g.set(index.pq_dim + 4, tier="pq")
+        if index.has_fast_scan:
+            g.set(index.list_sig.shape[2] + 4, tier="sig")
     return out
 
 
@@ -1136,6 +1528,179 @@ def _pq_search(index: IvfPqIndex, queries, n_probes: int, k: int, query_tile: in
         return select_k_impl(cv, ci, k, not inner, impl=select_impl)
 
     with tracing.range("ivf_pq.search.scan"):
+        dists, idx = lax.map(per_tile, (qt, pt))
+    dists = dists.reshape(num * query_tile, k)[:m]
+    idx = idx.reshape(num * query_tile, k)[:m]
+    if not inner and metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        dists = jnp.where(jnp.isfinite(dists), jnp.sqrt(jnp.maximum(dists, 0.0)), dists)
+    if keep_mask is not None:
+        # filtered-out candidates carry ±inf scores — report id -1
+        idx = jnp.where(jnp.isinf(dists), -1, idx)
+    return dists, idx
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_probes", "k", "k_widen", "query_tile", "probe_chunk",
+                     "metric", "codebook_kind", "lut_dtype", "select_impl"),
+)
+def _pq_search_funnel(index: IvfPqIndex, queries, n_probes: int, k: int,
+                      k_widen: int, query_tile: int, probe_chunk: int,
+                      metric: DistanceType, codebook_kind: str, lut_dtype: str,
+                      keep_mask=None, select_impl: str = "auto"):
+    """Three-stage quantization funnel (docs/tuning.md "Quantization
+    funnel"): binary widen → PQ rerank → the caller's exact refine.
+
+    Stage A scores EVERY probed slot against the packed fast-scan tier
+    (``list_sig``) with the same nibble-split one-hot contraction as the
+    pq8_split scan — the 32-entry signature LUT makes the contracted axis
+    ``sig_words * 32`` (for 1bit at d=128: half of classic pq4's), and the
+    operand bytes are the packed signatures, not the PQ codes. Stage B
+    re-scores only the per-chunk top ``k_widen`` survivors against the
+    full PQ codes by direct decode (exact PQ scores; the split cross term
+    rides in the decoded sum, no list_consts needed). Both selects and the
+    chunk merge route through the one ``select_k`` dispatch with the
+    shared ``-1/±inf`` sentinel, so no new merge shapes are minted, and
+    candidates the estimator filtered (dead slots, sample-filter hits)
+    keep their ±inf score through the rerank — they cannot resurrect.
+    """
+    m, d = queries.shape
+    qf = queries.astype(jnp.float32)
+    inner = metric == DistanceType.InnerProduct
+    pq_dim, pq_len = index.pq_dim, index.pq_len
+    d_rot = index.rot_dim
+    sig_w = index.list_sig.shape[2]
+    n_codes = index.codebooks.shape[-2]
+
+    # ---- stage 1: coarse clusters (shared with the classic scan) ----
+    with tracing.range("ivf_pq.search.coarse"):
+        cscore = qf @ index.centers.T
+        if not inner:
+            cn = jnp.sum(index.centers * index.centers, axis=1)
+            cscore = cn[None, :] - 2.0 * cscore
+        _, probes = _select_k(cscore, None, n_probes, not inner)  # (m, p)
+
+    qrot = qf @ index.rotation.T  # (m, d_rot)
+
+    num = -(-m // query_tile)
+    pad = num * query_tile - m
+    qp = jnp.pad(qrot, ((0, pad), (0, 0))) if pad else qrot
+    pp = jnp.pad(probes, ((0, pad), (0, 0))) if pad else probes
+    qt = qp.reshape(num, query_tile, d_rot)
+    pt = pp.reshape(num, query_tile, n_probes)
+
+    n_chunks = n_probes // probe_chunk
+    cap = index.capacity
+    fast_scan = index.fast_scan
+    cb = index.codebooks.astype(jnp.float32)
+    # binary contraction dtype follows lut_dtype (int8 is rejected at the
+    # dispatcher — the symmetric-scale quantization is a PQ-LUT-range
+    # optimization and the estimator tier is already 1-4 bits)
+    ct = jnp.bfloat16 if lut_dtype == "bfloat16" else jnp.float32
+
+    def per_tile(args):
+        q, pr = args  # (T, d_rot), (T, p)
+
+        def per_chunk(c, _):
+            pc = lax.dynamic_slice_in_dim(pr, c * probe_chunk, probe_chunk, axis=1)  # (T, pc)
+            crot = index.centers_rot[pc]        # (T, pc, d_rot)
+            ids = index.list_ids[pc]            # (T, pc, cap)
+            ss = jnp.take(index.sig_scales, pc, axis=0)  # (T, pc)
+
+            # ---- stage A: signature estimator over every probed slot.
+            # The nibble LUT carries raw = <r, σ> (1bit) / <r, lev> (4bit)
+            # in the RAW residual domain (sig_scales were fit there), so
+            # residual_scale_norm never enters the estimator:
+            #   L2  est = ‖r‖² + s²·d_rot − 2·s·raw   (‖σ‖² = d_rot for ±1;
+            #             the 4bit level-norm uses the same s²·d_rot model —
+            #             levels span ±2 per-dim RMS, so E‖lev‖² ≈ d_rot)
+            #   IP  est = q·c + s·raw
+            if inner:
+                r = jnp.broadcast_to(q[:, None, :],
+                                     (query_tile, probe_chunk, d_rot))
+            else:
+                r = q[:, None, :] - crot
+            slut = _sig_nibble_lut(r, fast_scan, sig_w)  # (T, pc, W, 32)
+            sig = index.list_sig[pc]                     # (T, pc, cap, W)
+            ar16 = jnp.arange(16, dtype=sig.dtype)
+            oh = jnp.concatenate(
+                [(sig >> 4)[..., None] == ar16,
+                 (sig & 0xF)[..., None] == ar16],
+                axis=-1)  # (T, pc, cap, W, 32)
+            ohf = oh.reshape(query_tile, probe_chunk, cap, sig_w * 32)
+            lutf = slut.reshape(query_tile, probe_chunk, sig_w * 32)
+            raw = lax.dot_general(
+                ohf.astype(ct), lutf.astype(ct),
+                (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32)  # (T, pc, cap)
+            if inner:
+                bias = jnp.einsum("td,tpd->tp", q, crot,
+                                  precision=lax.Precision.HIGHEST)
+                est = bias[:, :, None] + ss[:, :, None] * raw
+            else:
+                bias = jnp.sum(r * r, axis=-1)  # (T, pc) = ‖r‖² per probe
+                est = ((bias + ss * ss * d_rot)[:, :, None]
+                       - 2.0 * ss[:, :, None] * raw)
+            est = jnp.where(ids >= 0, est, -jnp.inf if inner else jnp.inf)
+            if keep_mask is not None:
+                from .sample_filter import apply_id_filter
+
+                est = apply_id_filter(est, ids, keep_mask, not inner)
+
+            # ---- widen: top-k_widen flat positions through select_k ----
+            flat_est = est.reshape(query_tile, probe_chunk * cap)
+            flat_pos = jnp.broadcast_to(
+                jnp.arange(probe_chunk * cap, dtype=jnp.int32)[None, :],
+                (query_tile, probe_chunk * cap))
+            est_sel, pos_sel = select_k_impl(flat_est, flat_pos, k_widen,
+                                             not inner, impl=select_impl)
+            probe_sel = pos_sel // cap           # (T, kw) chunk-local probe
+            slot_sel = pos_sel % cap
+            list_sel = jnp.take_along_axis(pc, probe_sel, axis=1)  # (T, kw)
+
+            # ---- stage B: PQ rerank of the survivors by direct decode ----
+            codes_sel = index.list_codes[list_sel, slot_sel]  # (T, kw, pq_dim)
+            ids_sel = index.list_ids[list_sel, slot_sel]      # (T, kw)
+            if index.pq_split:
+                # nibble one-hot over the 32-entry split codebook decodes
+                # cb1[hi] + cb2[lo] in one contraction (cross term included)
+                ohc = jnp.concatenate(
+                    [(codes_sel >> 4)[..., None] == ar16,
+                     (codes_sel & 0xF)[..., None] == ar16],
+                    axis=-1)  # (T, kw, pq_dim, 32)
+            else:
+                ohc = (codes_sel[..., None]
+                       == jnp.arange(n_codes, dtype=codes_sel.dtype))
+            if codebook_kind == "per_cluster":
+                dec = jnp.einsum("twsk,twkl->twsl", ohc.astype(jnp.float32),
+                                 cb[list_sel],
+                                 precision=lax.Precision.HIGHEST)
+            else:
+                dec = jnp.einsum("twsk,skl->twsl", ohc.astype(jnp.float32),
+                                 cb, precision=lax.Precision.HIGHEST)
+            dec = dec.reshape(query_tile, k_widen, d_rot)
+            if index.scale_normed:
+                # codes decode to s_list · codeword (residual_scale_norm)
+                dec = dec * jnp.take(index.list_scales, list_sel)[..., None]
+            crot_sel = index.centers_rot[list_sel]  # (T, kw, d_rot)
+            if inner:
+                score = jnp.einsum("td,twd->tw", q, crot_sel + dec,
+                                   precision=lax.Precision.HIGHEST)
+            else:
+                rr = q[:, None, :] - crot_sel - dec
+                score = jnp.sum(rr * rr, axis=-1)
+            # estimator-filtered survivors keep their ±inf score (their
+            # slots/ids may be real rows the sample filter dropped)
+            score = jnp.where(jnp.isfinite(est_sel), score, est_sel)
+            return c + 1, select_k_impl(score, ids_sel, k, not inner,
+                                        impl=select_impl)
+
+        _, (cv, ci) = lax.scan(per_chunk, 0, None, length=n_chunks)
+        cv = jnp.moveaxis(cv, 0, 1).reshape(query_tile, n_chunks * k)
+        ci = jnp.moveaxis(ci, 0, 1).reshape(query_tile, n_chunks * k)
+        return select_k_impl(cv, ci, k, not inner, impl=select_impl)
+
+    with tracing.range("ivf_pq.search.funnel"):
         dists, idx = lax.map(per_tile, (qt, pt))
     dists = dists.reshape(num * query_tile, k)[:m]
     idx = idx.reshape(num * query_tile, k)[:m]
@@ -1382,10 +1947,25 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
         expects(k <= TOPK_MAX_K,
                 "select_impl='pallas' selects with the streaming kernel: "
                 "k=%d must be <= %d", k, TOPK_MAX_K)
+    widen = int(params.funnel_widen)
+    expects(widen >= 1, "funnel_widen must be >= 1, got %d", widen)
+    if widen > 1:
+        expects(index.has_fast_scan,
+                "funnel_widen=%d widens through the fast-scan tier, but "
+                "this index carries none — build with "
+                "IndexParams.fast_scan='1bit'|'4bit'", widen)
+    # funnel_widen == 1 is the classic scan BY CONSTRUCTION (ids bit-equal):
+    # the funnel dispatch below is taken only for a real widen factor
+    use_funnel = widen > 1
+    if use_funnel:
+        bytes_per_probe_row = funnel_scan_bytes_per_probe_row(
+            index.capacity, index.list_sig.shape[2])
+    else:
+        bytes_per_probe_row = pq_scan_bytes_per_probe_row(
+            index.capacity, index.pq_dim, n_codes)
     query_tile, probe_chunk = plan_search_tiles(
         m, n_probes, int(k), index.capacity,
-        bytes_per_probe_row=pq_scan_bytes_per_probe_row(
-            index.capacity, index.pq_dim, n_codes),
+        bytes_per_probe_row=bytes_per_probe_row,
         budget_bytes=res.workspace_bytes,
         max_query_tile=128,
     )
@@ -1404,6 +1984,26 @@ def search(params: SearchParams, index: IvfPqIndex, queries, k: int,
         # -traffic cut bought nothing — the tiled one-hot contraction is not
         # operand-bound; BASELINE.md "Round-4 grouped scan")
         scan_order = "tiled"
+    if use_funnel:
+        expects(scan_order == "tiled",
+                "funnel_widen > 1 rides the tiled scan order; set "
+                "scan_order='tiled' (or 'auto')")
+        expects(scan_impl == "onehot",
+                "funnel_widen > 1 implements the one-hot signature "
+                "contraction; set scan_impl='onehot' (or 'auto')")
+        expects(params.lut_dtype != "int8",
+                "lut_dtype='int8' quantizes the PQ LUT; the funnel's "
+                "signature tier is already 1-4 bit — use float32/bfloat16")
+        # per-chunk widen pool: at least k (the rerank must fill the final
+        # select), at most every slot the chunk scans
+        k_widen = max(int(k), min(widen * int(k),
+                                  probe_chunk * index.capacity))
+        if metrics.enabled():
+            _quant_funnel_total().inc()
+        return _pq_search_funnel(
+            index, queries, n_probes, int(k), k_widen, query_tile,
+            probe_chunk, index.metric, index.codebook_kind, params.lut_dtype,
+            keep_mask, select_impl=params.select_impl)
     if scan_order == "grouped":
         expects(k <= index.capacity,
                 "scan_order='grouped' selects per (pair, list): k=%d must be "
@@ -1440,6 +2040,17 @@ def write_index(f, index: IvfPqIndex) -> None:
                 index.list_consts, index.list_scales):
         serialize_mdspan(f, arr)
     serialize_tuned(f, index.tuned)
+    # raft_tpu/13 quantization-codec record (trailing, after tuned — the
+    # serialize_tuned shared-layout discipline). Gated on the CURRENT
+    # format version through the module attribute, so a writer pinned to
+    # an older version (back-compat tests monkeypatch it) emits true
+    # old-layout bytes.
+    if version_number(core_serialize.SERIALIZATION_VERSION) >= 13:
+        serialize_scalar(f, index.rotation_kind)
+        serialize_scalar(f, index.codebook_loss)
+        serialize_scalar(f, index.fast_scan)
+        serialize_mdspan(f, index.list_sig)
+        serialize_mdspan(f, index.sig_scales)
 
 
 def read_index(f) -> IvfPqIndex:
@@ -1467,9 +2078,26 @@ def read_index(f) -> IvfPqIndex:
     # raft_tpu/9 appended the optional tuned record (pinned operating
     # point); older files are untuned
     tuned = deserialize_tuned(f, ver)
+    # raft_tpu/13 appended the quantization-codec record; /12-and-older
+    # files carry the codec defaults exactly (no learned rotation — any
+    # rotation they DO have is already folded into the serialized matrix —
+    # l2 loss, no fast-scan tier)
+    if version_number(ver) >= 13:
+        rotation_kind = deserialize_scalar(f)
+        codebook_loss = deserialize_scalar(f)
+        fast_scan = deserialize_scalar(f)
+        arrs.append(jnp.asarray(deserialize_mdspan(f)))  # list_sig
+        arrs.append(jnp.asarray(deserialize_mdspan(f)))  # sig_scales
+    else:
+        rotation_kind, codebook_loss, fast_scan = "none", "l2", "none"
+        n_lists = arrs[0].shape[0]
+        arrs.append(jnp.zeros((n_lists, 0, 0), jnp.uint8))
+        arrs.append(jnp.zeros((0,), jnp.float32))
     return IvfPqIndex(*arrs, metric=metric, codebook_kind=codebook_kind, pq_bits=pq_bits,
                       split_factor=split_factor, pq_split=pq_split,
-                      data_kind=kind, tuned=tuned)
+                      data_kind=kind, rotation_kind=rotation_kind,
+                      codebook_loss=codebook_loss, fast_scan=fast_scan,
+                      tuned=tuned)
 
 
 def save(index: IvfPqIndex, path: str) -> None:

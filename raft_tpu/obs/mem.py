@@ -635,6 +635,14 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
             bk["list_consts"] = n_lists * cap * 4
         if getattr(p, "residual_scale_norm", False):
             bk["list_scales"] = n_lists * 4
+        # fast-scan funnel tier (IndexParams.fast_scan): bit-packed
+        # signatures ride next to the codes (1bit: d_rot/8 B/slot, 4bit:
+        # d_rot/2) plus the per-list decode scales
+        fast_scan = getattr(p, "fast_scan", "none")
+        if fast_scan != "none":
+            sig_words = ivf_pq._sig_words(d_rot, fast_scan)
+            bk["list_sig"] = n_lists * cap * sig_words
+            bk["sig_scales"] = n_lists * 4
         # build peak: the f32 working copy plus the rotated-residual
         # trainset ((trainset, d_rot) f32) dominate the transients
         n_train = max(int(rows * p.kmeans_trainset_fraction), n_lists)

@@ -539,6 +539,15 @@ class MutableIndex:
             # default params at WRAP time, not an AttributeError at first
             # search (which could land on a serving thread)
             search_params = module.SearchParams()
+        if (kind == "ivf_pq"
+                and getattr(search_params, "funnel_widen", 1) > 1):
+            # fail the funnel/tier mismatch at WRAP time, not on a serving
+            # thread at first search (same rationale as the default above)
+            expects(sealed.has_fast_scan,
+                    "search_params pins funnel_widen=%d but the sealed "
+                    "index carries no fast-scan tier — build with "
+                    "IndexParams.fast_scan='1bit'|'4bit'",
+                    int(search_params.funnel_widen))
         cfg = _Config(kind=kind, module=module, search_params=search_params,
                       metric=metric, metric_arg=metric_arg,
                       select_min=metric != DistanceType.InnerProduct,

@@ -44,11 +44,13 @@ from . import reference
 from .apply import (apply_global, attach, make_searcher, resolve,
                     tuned_search_params)
 from .decisions import Decision, DecisionLog, family_of, kind_of, shape_family
-from .sweep import Trial, default_grid, smoke_grid, sweep, sweep_select_k
+from .sweep import (Trial, default_grid, funnel_grid, smoke_grid, sweep,
+                    sweep_select_k)
 
 __all__ = [
     "Decision", "DecisionLog", "shape_family", "family_of", "kind_of",
     "Trial", "sweep", "sweep_select_k", "default_grid", "smoke_grid",
+    "funnel_grid",
     "tuned_search_params", "make_searcher", "attach", "resolve",
     "apply_global", "reference",
 ]

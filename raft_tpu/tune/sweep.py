@@ -36,7 +36,8 @@ from ..obs import metrics
 from .decisions import (Decision, DecisionLog, family_of, kind_of,
                         shape_family)
 
-__all__ = ["Trial", "sweep", "sweep_select_k", "default_grid", "smoke_grid"]
+__all__ = ["Trial", "sweep", "sweep_select_k", "default_grid", "smoke_grid",
+           "funnel_grid"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -116,6 +117,23 @@ def smoke_grid(kind: str) -> list[dict]:
     ``--tune-smoke`` row — proves the measure→choose→record loop without
     the full grid's wall clock."""
     return default_grid(kind)[:3]
+
+
+def funnel_grid(widths=(4, 8, 16), refine_ratios=(1, 4)) -> list[dict]:
+    """The quantization-funnel sweep grid for an IVF-PQ index built with a
+    ``fast_scan`` tier: the two funnel widths as first-class knobs —
+    ``funnel_widen`` (binary tier → PQ rerank pool, per probed chunk) and
+    ``refine_ratio`` (PQ → exact refine pool). HEAD is the classic scan
+    (``funnel_widen=1``, bit-identical to a no-tier index), so
+    ``recall_target="default"`` anchors the funnel's recall to the classic
+    operating point — a funnel pin only wins by holding that anchor at
+    better QPS/bytes (docs/tuning.md "Quantization funnel")."""
+    grid = [{"n_probes": 8, "funnel_widen": 1, "refine_ratio": 4}]
+    for rr in refine_ratios:
+        for w in widths:
+            grid.append({"n_probes": 8, "funnel_widen": int(w),
+                         "refine_ratio": int(rr)})
+    return grid
 
 
 def _ground_truth(dataset, queries, k: int, metric="sqeuclidean"):

@@ -69,6 +69,15 @@ def check_filter_underfill():
         alive = sorted(expected_alive)
         n_alive = len(alive)
         bad = np.inf if select_min else -np.inf
+        if n_alive >= i.shape[1]:
+            # enough survivors to fill every slot: no sentinel may appear
+            # and every id must come from the alive set — a pre-filter
+            # tier (e.g. the ivf_pq funnel's binary stage) that silently
+            # narrowed the candidate pool would underfill or leak here
+            assert (i >= 0).all(), i
+            assert np.isfinite(d).all(), d
+            assert set(i.ravel().tolist()) <= set(alive), i
+            return
         assert (i[:, n_alive:] == -1).all(), i
         assert (d[:, n_alive:] == bad).all(), d
         assert np.isfinite(d[:, :n_alive]).all(), d

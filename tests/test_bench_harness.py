@@ -1092,3 +1092,88 @@ def test_compare_bench_r05_vs_itself_passes():
     assert len(out["rows"]) >= 5  # the artifact's named rows all matched
     assert compare.main([str(REPO / "BENCH_r05.json"),
                          str(REPO / "BENCH_r05.json")]) == 0
+
+
+def test_quant_funnel_row():
+    """The --quant bench row (ISSUE 16 acceptance): the same corpus built
+    classic vs 1bit-funnel with identical codec seeds, swept over
+    tune.funnel_grid. Every acceptance bit lives IN the row body (width-1
+    bit-equality, recall anchor, >=2x rows-per-HBM-byte, zero cold
+    compiles) — the small-scale twin must come back clean with the
+    frontier recorded in the decision evidence."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_quant_funnel(rows, n=20_000, d=64, n_lists=128, pq_dim=32,
+                            m=256, bucket=128, waves=3, ncl=200, repeats=1)
+    row = rows[-1]
+    assert row["name"] == "quant_funnel_100k" and "error" not in row, rows
+    assert row["capacity_x"] >= 2.0
+    assert row["bytes_per_row"] < row["bytes_per_row_classic"]
+    assert row["rows_per_hbm_byte"] > row["rows_per_hbm_byte_classic"]
+    assert row["recall"] >= row["recall_classic"] - 0.02
+    assert row["steady_compile_s"] == 0.0
+    assert row["steady_cache_misses"] == 0
+    assert row["qps"] > 0 and row["qps_classic"] > 0
+    assert row["n_trials"] >= 5 and row["frontier"], row
+    assert row["chosen"]["funnel_widen"] >= 1
+
+
+def test_quant_flag_runs_only_the_quant_row(monkeypatch):
+    """`bench.py --quant` is the funnel iteration loop: setup + the quant
+    row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_quant_funnel",
+        lambda rows: rows.append({"name": "quant_funnel_100k", "qps": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--quant"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "quant_funnel_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()
+
+
+def test_compare_gates_lost_capacity_measurement():
+    """The funnel capacity fields (bytes_per_row / rows_per_hbm_byte)
+    gate like recall fields on PRESENCE: a capacity measurement the old
+    artifact had and the new lost must FAIL (a harness bug dropping the
+    claim cannot pass as 'ok'), while byte-price drift between runs
+    gates nothing."""
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([
+        {"name": "q", "qps": 100.0, "recall": 0.9,
+         "bytes_per_row": 20, "rows_per_hbm_byte": 0.05},
+    ])
+    drifted = _artifact([
+        {"name": "q", "qps": 100.0, "recall": 0.9,
+         "bytes_per_row": 36, "rows_per_hbm_byte": 0.027},
+    ])
+    assert compare.compare(old, drifted)["regressions"] == [], (
+        "byte-price drift must not gate — presence does")
+    for lost in (
+        {"bytes_per_row": 20},   # rows_per_hbm_byte gone
+        {"rows_per_hbm_byte": 0.05},  # bytes_per_row gone
+        {},                      # both gone
+    ):
+        new = _artifact([{"name": "q", "qps": 100.0, "recall": 0.9, **lost}])
+        out = compare.compare(old, new)
+        assert out["regressions"] == ["q"], lost
+        missing = [c["field"] for r in out["rows"] for c in r["checks"]
+                   if c.get("missing")]
+        assert set(missing) <= {"bytes_per_row", "rows_per_hbm_byte"}, out
+        assert missing, out
+    # capacity fields the NEW artifact gained gate nothing
+    assert compare.compare(_artifact([{"name": "q", "qps": 1.0}]),
+                           old)["regressions"] == []

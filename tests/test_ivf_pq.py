@@ -846,3 +846,468 @@ class TestMinibatchEm:
         # driver-scale row's job); the bar that matters is PARITY
         assert recs["minibatch"] > 0.3, recs
         assert recs["minibatch"] >= recs["full"] - 0.03, recs
+
+
+class TestQuantFunnel:
+    """Quantization funnel (ISSUE 16): OPQ learned rotation, score-aware
+    (anisotropic) codebooks, and the bit-packed fast-scan pre-filter tier
+    (binary widen → exact-PQ rerank → caller refine). The load-bearing
+    contracts: funnel_widen=1 is BIT-EQUAL to a no-tier twin at the same
+    seed (the tier changes WHERE candidates come from, never what width-1
+    answers), filtered candidates keep their sentinels through every
+    stage, and the raft_tpu/13 codec record round-trips with /12
+    read-compat both directions."""
+
+    @pytest.fixture(scope="class")
+    def twins(self, data):
+        """Classic / 1bit-funnel twin builds at the same seed — identical
+        codebooks by construction (signature encoding consumes no RNG)."""
+        x, _ = data
+        base = dict(n_lists=16, pq_dim=16, seed=0)
+        classic = ivf_pq.build(ivf_pq.IndexParams(**base), x)
+        funnel = ivf_pq.build(
+            ivf_pq.IndexParams(fast_scan="1bit", **base), x)
+        return classic, funnel
+
+    def test_structure(self, twins):
+        classic, funnel = twins
+        assert funnel.has_fast_scan and funnel.fast_scan == "1bit"
+        # d_rot=32 → ceil(32/8)=4 packed sign-bit bytes per slot
+        assert funnel.list_sig.shape == (funnel.n_lists, funnel.capacity, 4)
+        assert funnel.list_sig.dtype == np.uint8
+        assert funnel.sig_scales.shape == (funnel.n_lists,)
+        assert not classic.has_fast_scan and classic.fast_scan == "none"
+        assert classic.list_sig.shape == (classic.n_lists, 0, 0)
+
+    def test_structure_4bit(self, data):
+        x, _ = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="4bit",
+                               seed=0), x)
+        # d_rot=32 → ceil(32/2)=16 packed nibble bytes per slot
+        assert idx.list_sig.shape == (idx.n_lists, idx.capacity, 16)
+        assert idx.fast_scan == "4bit"
+
+    def test_width1_bit_equal_classic(self, twins, data):
+        """The acceptance anchor: funnel_widen=1 routes the classic scan
+        untouched — ids AND distances bit-equal to the no-tier twin."""
+        _, q = data
+        classic, funnel = twins
+        dc, ic = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), classic,
+                               q, k=10)
+        df, if_ = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=8, funnel_widen=1), funnel, q, k=10)
+        np.testing.assert_array_equal(np.asarray(ic), np.asarray(if_))
+        np.testing.assert_array_equal(np.asarray(dc), np.asarray(df))
+
+    def test_funnel_recall_1bit(self, twins, data):
+        """Widened 1bit funnel holds the classic scan's recall: the binary
+        tier only has to RANK the true top-k into the top W·k per chunk."""
+        x, q = data
+        classic, funnel = twins
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        _, ic = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), classic,
+                              q, k=10)
+        _, if_ = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, funnel_widen=16), funnel,
+            q, k=10)
+        rec_c = _recall(np.asarray(ic), true_i)
+        rec_f = _recall(np.asarray(if_), true_i)
+        # the anchor is RELATIVE: this coarse pq4x16 codec tops out ~0.43
+        # on d=32 blobs, and the widened funnel must track it
+        assert rec_f > 0.3, rec_f
+        assert rec_f >= rec_c - 0.05, (rec_f, rec_c)
+
+    def test_funnel_recall_4bit_narrower_widen(self, data):
+        """4bit's lower estimator variance holds the anchor at half the
+        width the 1bit sizing rule starts from (the docs' W=4 start)."""
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="4bit",
+                               seed=0), x)
+        classic = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0), x)
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        _, ic = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), classic,
+                              q, k=10)
+        _, i4 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, funnel_widen=4), idx, q, k=10)
+        rec_c = _recall(np.asarray(ic), true_i)
+        rec_4 = _recall(np.asarray(i4), true_i)
+        assert rec_4 >= rec_c - 0.05, (rec_4, rec_c)
+
+    def test_inner_product_funnel(self, data):
+        x, q = data
+        base = dict(n_lists=16, pq_dim=16, metric="inner_product", seed=0)
+        classic = ivf_pq.build(ivf_pq.IndexParams(**base), x)
+        funnel = ivf_pq.build(
+            ivf_pq.IndexParams(fast_scan="1bit", **base), x)
+        true_i = np.argsort(-(q @ x.T), 1)[:, :10]
+        _, ic = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), classic,
+                              q, k=10)
+        _, if_ = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, funnel_widen=8), funnel, q, k=10)
+        rec_c = _recall(np.asarray(ic), true_i)
+        rec_f = _recall(np.asarray(if_), true_i)
+        assert rec_f >= rec_c - 0.1, (rec_f, rec_c)
+        # width 1 stays bit-equal under IP too
+        dc, ic1 = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), classic,
+                                q, k=5)
+        df, if1 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=8, funnel_widen=1), funnel, q, k=5)
+        np.testing.assert_array_equal(np.asarray(ic1), np.asarray(if1))
+        np.testing.assert_array_equal(np.asarray(dc), np.asarray(df))
+
+    def test_funnel_restrictions(self, twins, data):
+        """Every invalid funnel combination fails loudly at the entry
+        point, not as silent quality loss deep in the scan."""
+        from raft_tpu.core import RaftError
+
+        _, q = data
+        classic, funnel = twins
+        with pytest.raises(RaftError, match="fast[-_ ]?scan"):
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=8, funnel_widen=2),
+                          classic, q, k=10)
+        with pytest.raises(RaftError):
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=8, funnel_widen=0),
+                          funnel, q, k=10)
+        with pytest.raises(RaftError, match="tiled"):
+            ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=8, funnel_widen=2,
+                                    scan_order="grouped"), funnel, q, k=10)
+        with pytest.raises(RaftError, match="one-hot|onehot"):
+            ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=8, funnel_widen=2,
+                                    scan_impl="select"), funnel, q, k=10)
+        with pytest.raises(RaftError, match="int8"):
+            ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=8, funnel_widen=2,
+                                    lut_dtype="int8"), funnel, q, k=10)
+
+    def test_extend_carries_sig(self, data):
+        """extend() encodes signatures for the new rows through the same
+        per-list scales — the grown twin stays bit-equal to a grown
+        classic twin at width 1, and the widened funnel still serves."""
+        x, q = data
+        base = dict(n_lists=16, pq_dim=16, seed=0)
+        ids = np.arange(5000, 6000, dtype=np.int32)
+        f = ivf_pq.build(
+            ivf_pq.IndexParams(fast_scan="1bit", **base), x[:5000])
+        f = ivf_pq.extend(f, x[5000:], ids)
+        c = ivf_pq.build(ivf_pq.IndexParams(**base), x[:5000])
+        c = ivf_pq.extend(c, x[5000:], ids)
+        assert f.size == 6000 and f.has_fast_scan
+        assert f.list_sig.shape == (f.n_lists, f.capacity, 4)
+        dc, ic = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), c, q, k=10)
+        df, if_ = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=8, funnel_widen=1), f, q, k=10)
+        np.testing.assert_array_equal(np.asarray(ic), np.asarray(if_))
+        np.testing.assert_array_equal(np.asarray(dc), np.asarray(df))
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        _, iw = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, funnel_widen=8), f, q, k=10)
+        assert _recall(np.asarray(iw), true_i) > 0.3  # coarse-codec anchor
+
+    def test_underfill_sentinels_funnel(self, data, check_filter_underfill):
+        """Filtered candidates keep their -1/±inf sentinel through the
+        binary stage, the PQ rerank and the final merge (same shared
+        checker as the classic path)."""
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="1bit",
+                               seed=0), x)
+        alive = [44, 1023, 5020]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=64, funnel_widen=4), idx, q, 10,
+            sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    def test_underfill_sentinels_funnel_inner_product(
+            self, data, check_filter_underfill):
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="1bit",
+                               metric="inner_product", seed=0), x)
+        alive = [3, 997]
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=64, funnel_widen=4), idx, q, 10,
+            sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=False)
+
+    def test_filter_fills_k_when_enough_survive(self, data,
+                                                check_filter_underfill):
+        """The other side of the underfill contract: with >= k survivors
+        the funnel must FILL every slot from the alive set — a binary
+        stage that silently narrowed the pool would leak sentinels here."""
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="1bit",
+                               seed=0), x)
+        alive = list(range(100, 140))  # 40 survivors >= k=10
+        keep = np.zeros(x.shape[0], bool)
+        keep[alive] = True
+        d, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=idx.n_lists, funnel_widen=8),
+            idx, q, 10, sample_filter=keep)
+        check_filter_underfill(d, i, alive, select_min=True)
+
+    # -- serialize: raft_tpu/13 codec record, /12 read-compat ---------------
+
+    def test_serialize_13_roundtrip(self, tmp_path, data):
+        """The /13 codec record (rotation_kind, codebook_loss, fast_scan,
+        list_sig, sig_scales) round-trips and the loaded funnel serves
+        bit-equal at the widened point too."""
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, rotation="opq",
+                               fast_scan="1bit", seed=0), x)
+        p = str(tmp_path / "funnel13.bin")
+        ivf_pq.save(idx, p)
+        idx2 = ivf_pq.load(p)
+        assert idx2.rotation_kind == "opq"
+        assert idx2.fast_scan == "1bit" and idx2.has_fast_scan
+        np.testing.assert_array_equal(np.asarray(idx.list_sig),
+                                      np.asarray(idx2.list_sig))
+        np.testing.assert_array_equal(np.asarray(idx.sig_scales),
+                                      np.asarray(idx2.sig_scales))
+        sp = ivf_pq.SearchParams(n_probes=8, funnel_widen=4)
+        d1, i1 = ivf_pq.search(sp, idx, q, k=5)
+        d2, i2 = ivf_pq.search(sp, idx2, q, k=5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_serialize_12_read_compat_both_directions(self, tmp_path, data,
+                                                      monkeypatch):
+        """(a) Bytes written by a writer PINNED to raft_tpu/12 (pre-codec
+        layout) load in this build as a classic index — no tier, classic
+        search bit-equal; (b) this build's /13 bytes of a NO-tier index
+        read back classic too (the record is present but empty)."""
+        from raft_tpu.core import RaftError
+        from raft_tpu.core import serialize as core_serialize
+
+        x, q = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, fast_scan="1bit",
+                               seed=0), x)
+        old_path = str(tmp_path / "v12.bin")
+        monkeypatch.setattr(core_serialize, "SERIALIZATION_VERSION",
+                            "raft_tpu/12")
+        ivf_pq.save(idx, old_path)
+        monkeypatch.undo()
+        assert core_serialize.version_number(
+            core_serialize.SERIALIZATION_VERSION) >= 13
+        old = ivf_pq.load(old_path)
+        assert old.fast_scan == "none" and not old.has_fast_scan
+        assert old.list_sig.shape == (old.n_lists, 0, 0)
+        d1, i1 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=8, funnel_widen=1), idx, q, k=5)
+        d2, i2 = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), old, q, k=5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        # the tier did NOT survive the /12 bytes: widening must refuse
+        with pytest.raises(RaftError):
+            ivf_pq.search(ivf_pq.SearchParams(n_probes=8, funnel_widen=4),
+                          old, q, k=5)
+
+        classic = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, seed=0), x)
+        new_path = str(tmp_path / "v13_no_tier.bin")
+        ivf_pq.save(classic, new_path)
+        back = ivf_pq.load(new_path)
+        assert back.fast_scan == "none" and not back.has_fast_scan
+
+    # -- OPQ rotation (funnel stage a) --------------------------------------
+
+    def test_opq_rotation_orthonormal(self, data):
+        x, _ = data
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, rotation="opq",
+                               seed=0), x)
+        assert idx.rotation_kind == "opq"
+        r = np.asarray(idx.rotation)
+        np.testing.assert_allclose(r @ r.T, np.eye(r.shape[0]), atol=1e-4)
+
+    def test_opq_recall_holds_baseline(self, data):
+        """OPQ must never cost recall (it is a no-op by construction on
+        isotropic data; blobs sit close to that regime)."""
+        x, q = data
+        true_i = np.argsort(sp_dist.cdist(q, x, "sqeuclidean"), 1)[:, :10]
+        recs = {}
+        for rot in ("none", "opq"):
+            idx = ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=16, pq_dim=16, rotation=rot,
+                                   seed=0), x)
+            _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx,
+                                 q, k=10)
+            recs[rot] = _recall(np.asarray(i), true_i)
+        assert recs["opq"] >= recs["none"] - 0.05, recs
+
+    # -- anisotropic codebooks (funnel stage b) -----------------------------
+
+    def test_anisotropic_ip_recall(self, data):
+        """Score-aware codebooks target inner-product serving: recall at
+        the IP operating point must hold the plain-loss baseline."""
+        x, q = data
+        true_i = np.argsort(-(q @ x.T), 1)[:, :10]
+        recs = {}
+        for loss in ("l2", "anisotropic"):
+            idx = ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                   metric="inner_product",
+                                   codebook_loss=loss, seed=0), x)
+            _, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), idx,
+                                 q, k=10)
+            recs[loss] = _recall(np.asarray(i), true_i)
+        assert recs["anisotropic"] >= recs["l2"] - 0.05, recs
+
+    def test_anisotropic_rejects_split_pq8(self, data):
+        """The split-pq8 codebook's two stages share one proxy EM — the
+        anisotropic weighting cannot thread through it and must refuse."""
+        from raft_tpu.core import RaftError
+
+        with pytest.raises(RaftError, match="anisotropic"):
+            ivf_pq.build(
+                ivf_pq.IndexParams(n_lists=16, pq_dim=8, pq_bits=8,
+                                   pq8_split=True,
+                                   codebook_loss="anisotropic", seed=0),
+                data[0])
+
+    # -- stream embedding + tiered/sharded composition ----------------------
+
+    @pytest.fixture(scope="class")
+    def small_corpus(self):
+        r = np.random.default_rng(7)
+        X = r.standard_normal((2048, 16)).astype(np.float32)
+        Q = r.standard_normal((32, 16)).astype(np.float32)
+        return X, Q
+
+    def test_stream_embedded_13_roundtrip(self, small_corpus, tmp_path):
+        """A funnel index embedded in a stream file rides the /13 codec
+        record: the reloaded sealed index keeps the tier and the widened
+        funnel pin serves bit-equal."""
+        from raft_tpu import stream
+
+        X, Q = small_corpus
+        params = ivf_pq.IndexParams(n_lists=32, pq_bits=4, pq_dim=8,
+                                    fast_scan="1bit", seed=0)
+        sp = ivf_pq.SearchParams(n_probes=8, funnel_widen=4)
+        sealed = ivf_pq.build(params, X)
+        m = stream.MutableIndex(sealed, search_params=sp,
+                                index_params=params, dataset=X,
+                                name="funnel13")
+        path = str(tmp_path / "funnel13.stream")
+        stream.save(m, path)
+        rec = stream.load(path, search_params=sp)
+        assert rec._state.sealed.has_fast_scan
+        assert rec._state.sealed.fast_scan == "1bit"
+        d1, i1 = m.search(Q, 10)
+        d2, i2 = rec.search(Q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_wrap_refuses_funnel_pin_without_tier(self, small_corpus):
+        """The funnel/tier mismatch fails at WRAP time, not on a serving
+        thread mid-request."""
+        from raft_tpu import stream
+        from raft_tpu.core import RaftError
+
+        X, _ = small_corpus
+        params = ivf_pq.IndexParams(n_lists=32, pq_bits=4, pq_dim=8, seed=0)
+        sealed = ivf_pq.build(params, X)
+        with pytest.raises(RaftError, match="fast[-_ ]?scan"):
+            stream.MutableIndex(
+                sealed, search_params=ivf_pq.SearchParams(n_probes=8,
+                                                          funnel_widen=4),
+                index_params=params, dataset=X, name="funnel_guard")
+
+    def test_tiered_composition_width1_bit_equal(self, small_corpus):
+        """ISSUE 16 acceptance: the funnel index under tiered storage at
+        width 1 answers bit-equal (ids AND distances) to the all-HBM
+        classic-PQ twin — composition changes placement, never answers."""
+        from raft_tpu import stream
+
+        X, Q = small_corpus
+        base = dict(n_lists=32, pq_bits=4, pq_dim=8, seed=0)
+        classic = ivf_pq.build(ivf_pq.IndexParams(**base), X)
+        funnel = ivf_pq.build(
+            ivf_pq.IndexParams(fast_scan="1bit", **base), X)
+        a = stream.MutableIndex(
+            classic, search_params=ivf_pq.SearchParams(n_probes=8),
+            index_params=ivf_pq.IndexParams(**base), dataset=X,
+            storage="hbm", name="cmp_hbm_classic")
+        b = stream.MutableIndex(
+            funnel,
+            search_params=ivf_pq.SearchParams(n_probes=8, funnel_widen=1),
+            index_params=ivf_pq.IndexParams(fast_scan="1bit", **base),
+            dataset=X, storage="tiered",
+            tier=stream.TierPolicy(oracle_chunk=512, auto_promote=False),
+            name="cmp_tiered_funnel")
+        da, ia = a.search(Q, 10)
+        db, ib = b.search(Q, 10)
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+        dra, ira = a.search_refined(Q, 10, 4)
+        drb, irb = b.search_refined(Q, 10, 4)
+        np.testing.assert_array_equal(np.asarray(ira), np.asarray(irb))
+        np.testing.assert_array_equal(np.asarray(dra), np.asarray(drb))
+
+    def test_sharded_composition_width1_bit_equal(self, small_corpus):
+        """The sharded half of the composition acceptance: per-shard
+        funnel builds at width 1 scatter-gather to the same ids as the
+        classic-build sharded twin."""
+        from raft_tpu import stream
+
+        X, Q = small_corpus
+        base = dict(n_lists=8, pq_bits=4, pq_dim=8, seed=0)
+        a = stream.ShardedMutableIndex(
+            X, n_shards=2,
+            build=lambda x: ivf_pq.build(ivf_pq.IndexParams(**base), x),
+            search_params=ivf_pq.SearchParams(n_probes=8),
+            name="shard_classic")
+        b = stream.ShardedMutableIndex(
+            X, n_shards=2,
+            build=lambda x: ivf_pq.build(
+                ivf_pq.IndexParams(fast_scan="1bit", **base), x),
+            search_params=ivf_pq.SearchParams(n_probes=8, funnel_widen=1),
+            name="shard_funnel")
+        da, ia = a.search(Q, 10)
+        db, ib = b.search(Q, 10)
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+
+
+def test_funnel_sweep_1m_opq():
+    """Heavy 1M funnel sweep (slow manifest; the ISSUE 16 capacity bar at
+    the 1M recall anchor): an OPQ+1bit index swept over tune.funnel_grid
+    must pin a widened operating point that holds the classic anchor,
+    with the recall-vs-QPS frontier in the decision evidence, at >= 2x
+    rows per hot-scan HBM byte."""
+    from raft_tpu import tune
+    from raft_tpu.neighbors import brute_force
+
+    n, d, k = 1_000_000, 32, 10
+    x, _ = make_blobs(n, d, n_clusters=1000, cluster_std=1.0, seed=9)
+    x = np.asarray(x)
+    q = x[:256]
+    _, gt = brute_force.knn(x, q, k)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=1024, pq_bits=4, pq_dim=16,
+                           rotation="opq", fast_scan="1bit", seed=0,
+                           kmeans_batch_rows=8192), x)
+    assert idx.has_fast_scan and idx.rotation_kind == "opq"
+    log = tune.DecisionLog()
+    dec = tune.sweep(idx, q, k=k, dataset=x, gt=np.asarray(gt),
+                     grid=tune.funnel_grid(), recall_target="default",
+                     repeats=1, log=log)
+    ev = dec.evidence
+    assert ev["target_met"], ev
+    assert len(ev["trials"]) >= 5 and ev["frontier"], ev
+    # the hot-scan capacity bar: classic streams pq_dim+4 B/row, the
+    # funnel sig_words+4 (1bit at d_rot=32 -> 4 packed bytes)
+    bpr_classic = int(idx.list_codes.shape[2]) + 4
+    bpr_funnel = int(idx.list_sig.shape[2]) + 4
+    assert bpr_classic / bpr_funnel >= 2.0, (bpr_classic, bpr_funnel)

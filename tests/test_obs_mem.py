@@ -414,6 +414,32 @@ def test_plan_cagra_within_20pct_at_100k(rng):
         obs_mem.release(tok)
 
 
+def test_plan_fast_scan_tier_within_20pct_at_100k(rng):
+    """ISSUE 16 satellite: plan() prices the fast-scan signature tier
+    (list_sig + sig_scales) inside the same ±20% contract — the packed
+    tier rides the padded-list capacity model, so its per-array slack is
+    the same slack as list_codes, and the decode scales are exact."""
+    import dataclasses
+
+    import jax
+
+    n, d = 100_000, 16
+    base = _plan_params(d)["ivf_pq"]
+    params = dataclasses.replace(base, fast_scan="1bit")
+    idx = _build_kind("ivf_pq", params,
+                      rng.random((n, d)).astype(np.float32))
+    jax.block_until_ready(jax.tree_util.tree_leaves(idx))
+    assert idx.has_fast_scan
+    _assert_plan_brackets("ivf_pq", params, idx, n, d)
+    with_tier = obs_mem.plan("ivf_pq", params, n, d)["breakdown"]
+    without = obs_mem.plan("ivf_pq", base, n, d)["breakdown"]
+    sig = int(np.asarray(idx.list_sig).nbytes)
+    assert abs(with_tier["list_sig"] - sig) <= 0.20 * sig, (
+        with_tier["list_sig"], sig)
+    assert with_tier["sig_scales"] == int(np.asarray(idx.sig_scales).nbytes)
+    assert set(with_tier) - set(without) == {"list_sig", "sig_scales"}
+
+
 @pytest.mark.slow
 def test_plan_cagra_full_build_at_100k(rng):
     """The full 100k CAGRA build vs the plan (slow manifest — the

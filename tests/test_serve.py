@@ -533,6 +533,39 @@ def test_publish_tuned_zero_cold_compile(dataset):
     svc.shutdown(drain=True)
 
 
+def test_publish_tuned_funnel_zero_cold_compile(dataset):
+    """ISSUE 16 acceptance: a tuned FUNNEL pin (funnel_widen > 1 on a
+    fast-scan index) publishes through the same warm ladder — every
+    post-publish bucket serves the widened three-stage path compile-free.
+    Widths are static shapes, so an unwarmed width would cold-compile
+    here; the attribution proves the ladder covered the pinned one."""
+    from raft_tpu import tune
+    from raft_tpu.obs import compile as obs_compile
+
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, pq_dim=8, fast_scan="1bit", seed=0),
+        dataset)
+    log = tune.DecisionLog()
+    log.add(tune.Decision(kind="ivf_pq", dtype="float32",
+                          family=tune.family_of(idx, dataset),
+                          params={"n_probes": 4, "funnel_widen": 4}))
+    clock = FakeClock()
+    svc = SearchService(max_batch=4, clock=clock, start_workers=False)
+    rep = svc.publish("funnel", idx, k=5, tuned=log)
+    assert rep["tuned"] == log.entries()[0].key
+    with obs_compile.attribution() as rec:
+        for rows in (1, 3, 4):
+            futs = [svc.submit("funnel", dataset[j:j + 1], 5)
+                    for j in range(rows)]
+            clock.advance(1.0)
+            svc.pump()
+            for f in futs:
+                d, i = f.result(timeout=5)
+                assert i.shape == (1, 5)
+    assert rec.compile_s == 0.0 and rec.cache_misses == 0
+    svc.shutdown(drain=True)
+
+
 def test_publish_tuned_excludes_search_params_and_hooks(bf, dataset):
     from raft_tpu import tune
 
