@@ -328,6 +328,40 @@ def _mem_attach(rows, start, before):
         pass
 
 
+def _events_snap():
+    """Journal counts-by-kind at a row-scope start, or None when metrics
+    are disabled — the disabled bench carries no "events" field, mirroring
+    the "obs"/"mem" fields. Cumulative counts, so ring eviction during the
+    scope cannot under-report."""
+    try:
+        from raft_tpu.obs import events as obs_events
+        from raft_tpu.obs import metrics as obs_metrics
+
+        if not obs_metrics.enabled():
+            return None
+        return obs_events.counts_by_kind()
+    except Exception:
+        return None
+
+
+def _events_delta(before):
+    """Per-kind event counts emitted since ``before`` (ISSUE 17: the
+    fault/reshard/tiered rows carry what the event plane SAW — a fence
+    that fired zero ``replica_fenced`` events is a lost measurement).
+    Gated by bench/compare.py on field presence like recall fields."""
+    if before is None:
+        return None
+    try:
+        from raft_tpu.obs import events as obs_events
+
+        after = obs_events.counts_by_kind()
+        delta = {k: after[k] - before.get(k, 0) for k in sorted(after)
+                 if after[k] - before.get(k, 0) > 0}
+        return delta
+    except Exception:
+        return None
+
+
 def _recall(ids, gt):
     import numpy as np
 
@@ -1991,6 +2025,7 @@ def _row_fault_smoke(rows, n=100_000, d=64, n_lists=512, k=10,
     from raft_tpu.testing import faults
 
     assert fence_at < heal_at < steps
+    ev_before = _events_snap()
     _note("fault smoke: dataset")
     rng = np.random.default_rng(11)
     x = rng.random((n, d), np.float32)
@@ -2041,6 +2076,11 @@ def _row_fault_smoke(rows, n=100_000, d=64, n_lists=512, k=10,
             sm.search(pool[:qbatch], k)
             if sm.health()["healthy_min"] == replicas:
                 recovery_s = time.perf_counter() - t_heal
+        # settle: "healthy" above can mean the fence merely EXPIRED —
+        # one more search routes the pending probe (probes win _pick)
+        # so the breaker actually closes and the heal reaches the
+        # event journal (replica_probe ok + replica_unfenced)
+        sm.search(pool[:qbatch], k)
         return {"failed": failed, "recovery_s": recovery_s,
                 "wall_s": time.perf_counter() - t0}
 
@@ -2076,7 +2116,7 @@ def _row_fault_smoke(rows, n=100_000, d=64, n_lists=512, k=10,
     assert rec.compile_s == 0.0, (
         f"loaded window compiled {rec.compile_s}s after rehearsal — "
         "failover/probe paths minted a new program")
-    rows.append({
+    row = {
         "name": "fault_smoke_100k", "n": n, "shards": shards,
         "replicas": replicas, "queries": steps,
         "failed_queries": out["failed"], "strikes": strikes,
@@ -2087,7 +2127,11 @@ def _row_fault_smoke(rows, n=100_000, d=64, n_lists=512, k=10,
         "fault_note": "one replica killed mid-load and revived; zero "
                       "failed queries, zero cold compiles; recovery_s = "
                       "fault cleared -> every replica serving",
-    })
+    }
+    events = _events_delta(ev_before)   # gated by compare.py on presence
+    if events is not None:
+        row["events"] = events
+    rows.append(row)
 
 
 def _row_crash_recovery(rows, n=100_000, d=64, n_lists=512, k=10,
@@ -2253,6 +2297,7 @@ def _row_reshard_churn(rows, n=100_000, d=64, n_lists=512, k=10,
     from raft_tpu.testing import faults
 
     assert reshard_at < steps and replicas >= 2
+    ev_before = _events_snap()
     _note("reshard churn: dataset")
     rng = np.random.default_rng(17)
     x = rng.random((n, d), np.float32)
@@ -2425,7 +2470,7 @@ def _row_reshard_churn(rows, n=100_000, d=64, n_lists=512, k=10,
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
-    rows.append({
+    row = {
         "name": "reshard_churn_100k", "n": n,
         "shards_from": shards, "shards_to": 2 * shards,
         "replicas": replicas,
@@ -2448,7 +2493,11 @@ def _row_reshard_churn(rows, n=100_000, d=64, n_lists=512, k=10,
                         "failed queries, zero cold compiles across the "
                         "flip; crash_recovery_s = load of a mesh killed "
                         "between successor swap and manifest write",
-    })
+    }
+    events = _events_delta(ev_before)   # gated by compare.py on presence
+    if events is not None:
+        row["events"] = events
+    rows.append(row)
 
 
 def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
@@ -2486,6 +2535,7 @@ def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
     from raft_tpu.obs import mem as obs_mem
     from raft_tpu.stream import MutableIndex, TierPolicy
 
+    ev_before = _events_snap()
     _note("tiered: dataset")
     dataset, qsets = _make_clustered(n, d, m, ncl, n_qsets=2, seed=13)
     jax.block_until_ready([dataset] + qsets)
@@ -2588,7 +2638,7 @@ def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
 
     qps_h = round(m * waves / sum(walls_h), 1)
     qps_t = round(m * waves / sum(walls_t), 1)
-    rows.append({
+    row = {
         "name": "tiered_100k", "n": n, "k": k, "refine_ratio": ratio,
         "qps": qps_t,
         "qps_hbm": qps_h,
@@ -2612,7 +2662,11 @@ def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
                        "twin, per-tier bytes flat across waves, zero "
                        "failed queries, zero cold compiles; "
                        "hbm_over_tiered is the measured host-hop cost",
-    })
+    }
+    events = _events_delta(ev_before)   # gated by compare.py on presence
+    if events is not None:
+        row["events"] = events
+    rows.append(row)
 
 
 def _row_quant_funnel(rows, n=100_000, d=128, n_lists=1024, pq_dim=64, k=10,

@@ -21,7 +21,10 @@ sub-fields (rows served through a TieredStore) gate the same way on
 PRESENCE: byte levels shift legitimately between runs, but a tier
 measurement the old artifact had and the new lost fails the gate. The
 quantization-funnel capacity fields (``bytes_per_row``,
-``rows_per_hbm_byte``) follow the same presence rule.
+``rows_per_hbm_byte``) follow the same presence rule, as do the
+per-kind ``events.*`` sub-fields (fault/reshard/tiered rows carry the
+event-journal counts their scope emitted — a fence window that stops
+producing ``replica_fenced`` events is a lost measurement).
 
 Accepts both the committed driver wrapper (``{n, cmd, rc, tail, parsed}``)
 and a bare bench snapshot (``{metric, value, rows, ...}``); an artifact
@@ -75,6 +78,18 @@ _CAPACITY_FIELDS = ("bytes_per_row", "rows_per_hbm_byte")
 def _capacity_keys(row: dict):
     return [k for k in _CAPACITY_FIELDS
             if isinstance(row.get(k), (int, float))]
+
+
+def _event_keys(row: dict):
+    """Per-kind ``events`` sub-fields (``events.replica_fenced`` ...):
+    present in rows whose scope rode the event journal (ISSUE 17). Gated
+    like the per-tier mem sub-fields — PRESENCE only: counts shift
+    legitimately run to run, but an event kind the old artifact observed
+    and the new lost must fail the gate, not pass silently."""
+    events = row.get("events")
+    if not isinstance(events, dict):
+        return []
+    return sorted(k for k, v in events.items() if isinstance(v, int))
 
 
 def _tier_get(row: dict, key: str):
@@ -159,6 +174,19 @@ def compare(old: dict, new: dict, *, qps_tol: float = 0.15,
             else:
                 row["checks"].append({"field": f"mem.tiers.{key}",
                                       "old": o["mem"]["tiers"][key],
+                                      "new": got})
+        for key in _event_keys(o):
+            got = n.get("events", {}).get(key) if isinstance(
+                n.get("events"), dict) else None
+            if not isinstance(got, int):
+                row["status"] = "regression"
+                row["checks"].append({"field": f"events.{key}",
+                                      "old": o["events"][key],
+                                      "new": None, "missing": True,
+                                      "regression": True})
+            else:
+                row["checks"].append({"field": f"events.{key}",
+                                      "old": o["events"][key],
                                       "new": got})
         out["rows"].append(row)
         if row["status"] == "regression":

@@ -28,8 +28,14 @@ control, and a bench harness that always writes structured results
   (leak detection on the registry/compaction/fold free paths), the
   per-kind footprint estimator ``mem.plan()``, and the
   ``Resources.memory_budget_bytes`` admission gate.
+- :mod:`.events` — the unified operations event plane: one process-wide
+  causally-ordered journal every advisory/transition call site emits
+  into (``emit(kind, subject=(component, name, shard, epoch), ...)``),
+  with subscriber taps, a durable JSONL sink, per-kind counts and the
+  incident flight recorder (SLO ``failing`` → postmortem bundle).
 - :mod:`.http` — the opt-in stdlib endpoint routing ``/metrics``,
-  ``/healthz``, ``/debug/requests`` and ``/debug/mem`` (404 elsewhere).
+  ``/healthz``, ``/debug/requests``, ``/debug/mem`` and
+  ``/debug/events`` (404 elsewhere).
 
 Trace annotation (the NVTX analogue) lives in :mod:`raft_tpu.core.tracing`;
 per-collective counters ride inside :mod:`raft_tpu.comms.comms`; the serving
@@ -45,6 +51,7 @@ metric catalogue.
 from . import build
 from . import compile  # noqa: A004 - submodule named like the builtin
 from . import dispatch
+from . import events
 from . import http
 from . import mem
 from . import metrics
@@ -60,6 +67,7 @@ from .instrument import instrument
 from .metrics import (DEFAULT_BUCKETS, RATIO_BUCKETS, Registry, counter,
                       delta, disable, enable, enabled, gauge, histogram,
                       quantile, reset, snapshot, to_json, to_prometheus)
+from .events import EventJournal
 from .quality import DriftDetector, RecallCanary, exact_oracle, wilson_interval
 from .requestlog import RequestLog
 from .slo import SLOPolicy, SLOTracker
@@ -72,5 +80,5 @@ __all__ = [
     "delta", "quantile", "reset", "enable", "disable", "enabled",
     "quality", "slo", "requestlog", "mem", "RecallCanary", "DriftDetector",
     "exact_oracle", "wilson_interval", "SLOPolicy", "SLOTracker",
-    "RequestLog",
+    "RequestLog", "events", "EventJournal",
 ]

@@ -19,6 +19,12 @@ daemon-threaded stdlib ``http.server``:
   ``(component, name, shard, epoch)``, retirement-audit status and
   per-device HBM stats where the backend reports them. Always routed —
   the ledger is a process singleton, nothing to attach.
+- ``/debug/events`` — the operations event journal
+  (:mod:`raft_tpu.obs.events`): the causally-ordered ring of advisory /
+  transition events, filterable by query string (``kind=``,
+  ``severity=``, ``component=``, ``name=``, ``since_seq=``, ``limit=``).
+  ``since_seq`` is exclusive — poll with the last seen ``seq`` to page
+  the tail without gaps or repeats. Always routed (process singleton).
 
 Every other path is a 404 — a scrape-config typo fails loudly at
 deploy time instead of silently scraping metrics from ``/metrcs`` forever
@@ -39,6 +45,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import metrics
@@ -117,6 +124,34 @@ class MetricsExporter:
 
                     self._send(200, _JSON_TYPE, json.dumps(
                         obs_mem.debug_payload(), default=float).encode())
+                elif path == "/debug/events":
+                    from . import events as obs_events
+
+                    qs = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+
+                    def _q(key):
+                        vals = qs.get(key)
+                        return vals[-1] if vals else None
+
+                    try:
+                        since = int(_q("since_seq") or 0)
+                        limit = (int(_q("limit"))
+                                 if _q("limit") is not None else None)
+                    except ValueError:
+                        self._send(400, _JSON_TYPE, json.dumps(
+                            {"error": "since_seq and limit must be "
+                                      "integers"}).encode())
+                        return
+                    evs = obs_events.query(
+                        kind=_q("kind"), severity=_q("severity"),
+                        component=_q("component"), name=_q("name"),
+                        since_seq=since, limit=limit)
+                    self._send(200, _JSON_TYPE, json.dumps(
+                        {"events": evs,
+                         "last_seq": obs_events.last_seq(),
+                         "counts_by_kind": obs_events.counts_by_kind()},
+                        default=float).encode())
                 elif path == "/debug/requests":
                     if exporter.request_log is None:
                         self._send(404, _JSON_TYPE, json.dumps(
@@ -133,7 +168,7 @@ class MetricsExporter:
                     self._send(404, "text/plain; charset=utf-8",
                                (f"unknown path {path!r}; endpoints: "
                                 "/metrics, /healthz, /debug/requests, "
-                                "/debug/mem\n").encode())
+                                "/debug/mem, /debug/events\n").encode())
 
             def log_message(self, fmt, *args):
                 # scrapes every few seconds must not spam stderr; the

@@ -63,6 +63,7 @@ import threading
 import time
 import weakref
 
+from . import events as obs_events
 from . import metrics
 
 __all__ = [
@@ -762,12 +763,23 @@ def gate(res, need_bytes, *, site: str, detail: str = "",
         if used + need > int(budget):
             # budget pressure: let registered relief (tier spills) free
             # device bytes, then re-check once
+            obs_events.emit(
+                "mem_pressure", subject=("mem", site, None, None),
+                evidence={"site": site, "need_bytes": need,
+                          "accounted_bytes": used,
+                          "budget_bytes": int(budget),
+                          "overage_bytes": used + need - int(budget)})
             _relieve(used + need - int(budget))
             used = _ledger.totals()["device_bytes"]
         if used + need > int(budget):
-            _c_refusals().inc(1, site=site)
             from ..serve.errors import MemoryBudgetError
 
+            obs_events.emit(
+                "budget_refusal", subject=("mem", site, None, None),
+                evidence={"site": site, "need_bytes": need,
+                          "accounted_bytes": used,
+                          "budget_bytes": int(budget)},
+                counter=_c_refusals, counter_labels={"site": site})
             raise MemoryBudgetError(
                 f"memory budget exceeded at {site}: accounted {used} B + "
                 f"needed {need} B > budget {int(budget)} B"
@@ -784,9 +796,16 @@ def gate(res, need_bytes, *, site: str, detail: str = "",
         # the OPPOSITE pinned contract (budgets armed after builds land
         # refuse zero-growth publishes) — do not unify them.
         if need_h and used_h + need_h > int(host_budget):
-            _c_refusals().inc(1, site=f"{site}/host")
             from ..serve.errors import MemoryBudgetError
 
+            obs_events.emit(
+                "budget_refusal",
+                subject=("mem", f"{site}/host", None, None),
+                evidence={"site": f"{site}/host", "need_bytes": need_h,
+                          "accounted_bytes": used_h,
+                          "budget_bytes": int(host_budget)},
+                counter=_c_refusals,
+                counter_labels={"site": f"{site}/host"})
             raise MemoryBudgetError(
                 f"host memory budget exceeded at {site}: accounted "
                 f"{used_h} B + needed {need_h} B > host budget "

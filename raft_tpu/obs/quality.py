@@ -41,6 +41,7 @@ the drift → retune loop.
 from __future__ import annotations
 
 import functools
+import itertools
 import math
 import random
 import threading
@@ -48,9 +49,13 @@ import time
 from typing import Callable
 
 from ..core.errors import expects
+from . import events as obs_events
 from . import metrics
 
 __all__ = ["RecallCanary", "DriftDetector", "exact_oracle", "wilson_interval"]
+
+# per-DriftDetector journal tags (see DriftDetector.events)
+_detector_ids = itertools.count()
 
 # the canary's rerank-batch ladder (power-of-two query buckets, mirroring
 # serve's): every rerank dispatch is one of these shapes, so warm() bounds
@@ -493,7 +498,10 @@ class DriftDetector:
         # still clean — a clean corpus check must not clear (and re-arm)
         # a standing query-side drift
         self._drifted: dict[str, bool] = {}
-        self.events: list[dict] = []
+        # per-instance journal tag: the `events` view below filters the
+        # process-wide journal by it, so two detectors sharing a name
+        # (or test cases reusing one) never read each other's advisories
+        self._jtag = f"{name}/{next(_detector_ids)}"
         self._max_events = int(max_events)
         self.last_report: dict | None = None
 
@@ -573,22 +581,36 @@ class DriftDetector:
         pin (what the ``raft_tpu_quality_family_drift`` gauge reports)."""
         return any(self._drifted.values())
 
-    def _emit_retune_advised(self, report: dict) -> None:
-        from ..core.logger import logger
+    @property
+    def events(self) -> list:
+        """The retune-advised history, as a thin view over the process
+        journal (:mod:`raft_tpu.obs.events`) — legacy dict shape
+        preserved (``{"event": "retune_advised", "name", "auto_apply",
+        **report}``), newest last, capped at ``max_events``."""
+        out = []
+        for ev in obs_events.query(kind="retune_advised", name=self.name):
+            e = ev["evidence"]
+            if e.get("tag") != self._jtag:
+                continue
+            out.append({"event": "retune_advised", "name": self.name,
+                        **{k: v for k, v in e.items() if k != "tag"}})
+        return out[-self._max_events:]
 
-        event = {"event": "retune_advised", "name": self.name,
-                 # advice only: applying another balance class's pin is the
-                 # measured r5 recall collapse — run a fresh sweep instead
-                 "auto_apply": False, **report}
-        with self._lock:
-            self.events.append(event)
-            del self.events[:-self._max_events]
-        if metrics._enabled:
-            _c_retune().inc(1, name=self.name)
-        logger.warning(
-            "family drift on %r: live distribution measures %s but the "
-            "pinned tune decision is keyed %s (scale_cv=%.3f, source=%s) — "
-            "retune advised; decisions are never auto-applied across "
-            "balance classes (BASELINE r5 non-transfer)",
-            self.name, report["observed"], report["pinned"],
-            report["scale_cv"], report["source"])
+    def _emit_retune_advised(self, report: dict) -> None:
+        # one emit = journal entry + counter + WARNING, atomically (the
+        # three can no longer disagree on re-arm paths); advice only:
+        # applying another balance class's pin is the measured r5 recall
+        # collapse — run a fresh sweep instead (auto_apply stays False)
+        obs_events.emit(
+            "retune_advised",
+            subject=("quality", self.name),
+            evidence={"auto_apply": False, "tag": self._jtag, **report},
+            counter=_c_retune, counter_labels={"name": self.name},
+            message=(
+                "family drift on %r: live distribution measures %s but the "
+                "pinned tune decision is keyed %s (scale_cv=%.3f, "
+                "source=%s) — retune advised; decisions are never "
+                "auto-applied across balance classes (BASELINE r5 "
+                "non-transfer)"),
+            log_args=(self.name, report["observed"], report["pinned"],
+                      report["scale_cv"], report["source"]))

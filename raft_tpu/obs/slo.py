@@ -42,6 +42,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..core.errors import expects
+from . import events as obs_events
 from . import metrics
 
 __all__ = ["SLOPolicy", "SLOTracker", "OBJECTIVES"]
@@ -116,6 +117,9 @@ class SLOTracker:
         self._ring = {o: [[0.0, 0.0] for _ in range(self._n_slots)]
                       for o in OBJECTIVES}
         self._slot: int | None = None
+        # last verdict seen by status() — the transition edge the
+        # slo_verdict journal event (and the flight recorder) fires on
+        self._last_status: str | None = None
         self._budget = {
             "availability": 1.0 - policy.availability_target,
             "latency": 1.0 - policy.latency_target,
@@ -241,6 +245,21 @@ class SLOTracker:
                 status = "degraded"
         if metrics._enabled:
             _g_status().set(_STATUS_CODE[status], name=self.name)
+        if status != self._last_status:
+            prev, self._last_status = self._last_status, status
+            # verdict TRANSITIONS journal once each (ready→failing and
+            # back both matter in a postmortem); a failing transition
+            # also trips the armed flight recorder inside emit()
+            obs_events.emit(
+                "slo_verdict",
+                severity=("error" if status == "failing" else
+                          "warning" if status == "degraded" else "info"),
+                subject=("slo", self.name, None, None),
+                evidence={"status": status, "previous": prev,
+                          "burn_rates": rates},
+                message=("SLO verdict for %r: %s (was %s)"
+                         if status != "ready" else None),
+                log_args=(self.name, status, prev))
         return status
 
     def healthz(self) -> tuple[int, dict]:

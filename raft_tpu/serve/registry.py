@@ -38,6 +38,7 @@ from typing import Callable
 
 from ..core.errors import RaftError, expects
 from ..core.resources import default_resources
+from ..obs import events as obs_events
 from ..obs import mem as obs_mem
 from ..obs import metrics
 
@@ -284,6 +285,11 @@ class IndexRegistry:
             for dead in to_retire:
                 self._retire(dead)
             report["version"] = v.version
+            obs_events.emit(
+                "serve_published",
+                subject=("serve", name, None, v.version),
+                evidence={"swap": old is not None, "warmed": warm,
+                          "ks": list(v.ks)})
             return report
 
     def publish_lock(self, name: str) -> threading.RLock:
@@ -304,6 +310,10 @@ class IndexRegistry:
         # to the index arrays, so this releases them to the allocator
         v.searcher = None
         _retired_total().inc(1, name=v.name)
+        obs_events.emit(
+            "serve_retired",
+            subject=("serve", v.name, None, v.version),
+            evidence={"leases": v.leases})
 
     # -- read side ----------------------------------------------------------
     def active(self, name: str) -> _Version:

@@ -30,16 +30,21 @@ deterministic folds) drive directly.
 from __future__ import annotations
 
 import functools
+import itertools
 import threading
 import time
 from dataclasses import dataclass
 from typing import Callable
 
 from ..core.errors import expects
+from ..obs import events as obs_events
 from ..obs import metrics
 from .mutable import MutableIndex
 
 __all__ = ["CompactionPolicy", "Compactor"]
+
+# per-Compactor journal transition keys (see last_advice)
+_compactor_ids = itertools.count()
 
 
 @functools.lru_cache(maxsize=None)
@@ -181,10 +186,12 @@ class Compactor:
         self._worker: threading.Thread | None = None
         self.last_report: dict | None = None
         self.last_error: BaseException | None = None
-        # standing reshard advisory (None while neither topology watermark
-        # is tripped); the counter/WARNING emit once per transition
-        self.last_advice: dict | None = None
-        self._advice_key: tuple | None = None
+        # the standing reshard advisory lives in the event journal's
+        # transition store (keyed per instance); last_advice below is a
+        # thin view over it — the counter/WARNING emit once per
+        # transition, dedup owned by the journal
+        self._advice_tkey = ("compactor/reshard_advice",
+                             next(_compactor_ids))
 
     # -- watermarks ---------------------------------------------------------
     def due(self) -> str | None:
@@ -204,13 +211,25 @@ class Compactor:
             return "age"
         return None
 
+    @property
+    def last_advice(self) -> dict | None:
+        """The STANDING reshard advisory — a dict while a topology
+        watermark stays crossed, None once it clears. A thin view over
+        the event journal's transition store
+        (:meth:`raft_tpu.obs.events.EventJournal.transition_payload`),
+        so it survives ring eviction and stays consistent with the
+        ``reshard_advised`` / ``reshard_advice_cleared`` events."""
+        return obs_events.transition_payload(self._advice_tkey)
+
     def _check_reshard(self) -> dict | None:
         """Evaluate the advisory topology watermarks (see
-        :class:`CompactionPolicy`): updates ``self.last_advice`` — a
-        STANDING advisory while a mark stays crossed, None once it clears
-        — emitting the ``reshard_advised`` counter + WARNING exactly once
-        per transition. Only meaningful for an index that can actually
-        reshard (a sharded mesh); silently None otherwise."""
+        :class:`CompactionPolicy`): updates the journal-backed
+        :attr:`last_advice` — a STANDING advisory while a mark stays
+        crossed, None once it clears — emitting the ``reshard_advised``
+        event (journal entry + counter + WARNING, atomically) exactly
+        once per transition; the dedup is the journal's. Only meaningful
+        for an index that can actually reshard (a sharded mesh);
+        silently None otherwise."""
         p = self.policy
         if (p.reshard_rows_per_shard is None
                 and p.reshard_min_rows_per_shard is None):
@@ -238,25 +257,32 @@ class Compactor:
                       "threshold": p.reshard_min_rows_per_shard}
         key = ((advice["action"], advice["target"])
                if advice is not None else None)
-        if key == self._advice_key:
-            return self.last_advice
-        self._advice_key = key
-        if advice is None:
-            self.last_advice = None
-            return None
-        from ..core.logger import logger
-
-        self.last_advice = dict(
+        payload = None if advice is None else dict(
             advice, name=self._mutable.name, shards=shards,
             rows_per_shard=round(per, 1), auto_apply=False)
-        if metrics._enabled:
-            _c_reshard_advised().inc(1, name=self._mutable.name,
-                                     action=advice["action"])
-        logger.warning(
-            "reshard advised for %r: %s to %d shards (%.0f live rows/shard "
-            "crossed %s=%d); advisory only — call reshard(%d) to apply",
-            self._mutable.name, advice["action"], advice["target"], per,
-            advice["watermark"], advice["threshold"], advice["target"])
+        if not obs_events.transition(self._advice_tkey, key, payload):
+            return self.last_advice
+        if advice is None:
+            obs_events.emit(
+                "reshard_advice_cleared",
+                subject=("compactor", self._mutable.name, None, None),
+                evidence={"shards": shards,
+                          "rows_per_shard": round(per, 1)})
+            return None
+        obs_events.emit(
+            "reshard_advised",
+            subject=("compactor", self._mutable.name, None, None),
+            evidence=payload,
+            counter=_c_reshard_advised,
+            counter_labels={"name": self._mutable.name,
+                            "action": advice["action"]},
+            message=(
+                "reshard advised for %r: %s to %d shards (%.0f live "
+                "rows/shard crossed %s=%d); advisory only — call "
+                "reshard(%d) to apply"),
+            log_args=(self._mutable.name, advice["action"],
+                      advice["target"], per, advice["watermark"],
+                      advice["threshold"], advice["target"]))
         return self.last_advice
 
     # -- one compaction cycle ----------------------------------------------
@@ -281,6 +307,9 @@ class Compactor:
         from ..obs import compile as obs_compile
 
         name = self._mutable.name
+        obs_events.emit("compaction_started",
+                        subject=("compactor", name, None, None),
+                        evidence={"trigger": trigger, "mode": mode})
         t0 = time.perf_counter()
         with obs_compile.attribution() as rec:
             kw = {"trigger": trigger} if self._compact_takes_trigger else {}
@@ -319,6 +348,13 @@ class Compactor:
             _h_wall().observe(wall, name=name)
             if rec.compile_s:
                 _c_compile().inc(rec.compile_s, name=name)
+        obs_events.emit(
+            "compaction_completed",
+            subject=("compactor", name, None, None),
+            evidence={"trigger": trigger, "mode": report["mode"],
+                      "wall_s": report["wall_s"],
+                      "compile_s": report["compile_s"],
+                      "published": "publish" in report})
         self.last_report = report
         return report
 
@@ -339,8 +375,6 @@ class Compactor:
         return self
 
     def _run(self) -> None:
-        from ..core.logger import logger
-
         while not self._stop.wait(self._poll_s):
             try:
                 self.run_once()
@@ -353,11 +387,17 @@ class Compactor:
                 self.last_error = e
                 if metrics._enabled:
                     _c_failures().inc(1, name=self._mutable.name)
-                if first:  # log once per failure kind, not per poll tick
-                    logger.warning(
-                        "compaction of %r failed (will keep retrying every "
-                        "%.2fs; see Compactor.last_error): %s",
-                        self._mutable.name, self._poll_s, e)
+                if first:  # emit once per failure kind, not per poll tick
+                    obs_events.emit(
+                        "compaction_failed",
+                        subject=("compactor", self._mutable.name,
+                                 None, None),
+                        evidence={"error": repr(e),
+                                  "poll_s": self._poll_s},
+                        message=(
+                            "compaction of %r failed (will keep retrying "
+                            "every %.2fs; see Compactor.last_error): %s"),
+                        log_args=(self._mutable.name, self._poll_s, e))
 
     def close(self, timeout_s: float = 30.0) -> None:
         """Stop the worker (a fold in flight finishes first). Idempotent.

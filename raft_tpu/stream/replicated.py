@@ -65,6 +65,7 @@ import numpy as np
 
 from ..core.errors import RaftError, expects
 from ..core.resources import default_resources
+from ..obs import events as obs_events
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..serve.errors import ReplicaUnavailableError
@@ -404,6 +405,7 @@ class ReplicatedShard:
             return None
 
     def _strike(self, j: int, reason: str, exc=None) -> None:
+        fenced = was_probe = False
         with self._hlock:
             h = self._health[j]
             h.consecutive += 1
@@ -412,19 +414,36 @@ class ReplicatedShard:
                 h.last_error = exc
             was_probe = h.fenced_until is not None
             if was_probe or h.consecutive >= self.policy.max_consecutive:
+                fenced = True
                 h.fenced_until = self._clock() + h.backoff
+                backoff = h.backoff
                 h.backoff = min(h.backoff * 2, self.policy.backoff_max_s)
                 if metrics._enabled:
                     _c_fenced().inc(1, name=self._name, reason=reason)
                     if was_probe:
                         _c_probes().inc(1, name=self._name, outcome="fail")
             self._update_health_gauges()
+        # journal OUTSIDE the health lock: a subscriber tap must never
+        # run (or block) under the breaker's lock
+        if fenced:
+            if was_probe:
+                obs_events.emit(
+                    "replica_probe", severity="warning",
+                    subject=("replica", self._name, j, None),
+                    evidence={"outcome": "fail", "reason": reason,
+                              "backoff_s": backoff})
+            obs_events.emit(
+                "replica_fenced",
+                subject=("replica", self._name, j, None),
+                evidence={"reason": reason, "backoff_s": backoff,
+                          "error": None if exc is None else repr(exc)})
 
     def _observe_ok(self, j: int, wall: float) -> bool:
         """Record a completed scan; returns True if it counted as a SLOW
         strike (the caller still returns the valid result)."""
         p = self.policy
         slow = p.deadline_s is not None and wall > p.deadline_s
+        unfenced = False
         with self._hlock:
             h = self._health[j]
             h.ewma = (wall if h.ewma is None
@@ -432,12 +451,24 @@ class ReplicatedShard:
             if slow:
                 pass  # strike accounting below, outside the success path
             else:
-                if h.fenced_until is not None and metrics._enabled:
-                    _c_probes().inc(1, name=self._name, outcome="ok")
+                if h.fenced_until is not None:
+                    unfenced = True
+                    if metrics._enabled:
+                        _c_probes().inc(1, name=self._name, outcome="ok")
                 h.consecutive = 0
                 h.fenced_until = None  # a successful probe closes the breaker
                 h.backoff = self.policy.backoff_s
             self._update_health_gauges()
+        if unfenced:
+            # probe ok + breaker close journal as one causal pair, outside
+            # the health lock
+            obs_events.emit("replica_probe",
+                            subject=("replica", self._name, j, None),
+                            evidence={"outcome": "ok",
+                                      "wall_s": round(wall, 6)})
+            obs_events.emit("replica_unfenced",
+                            subject=("replica", self._name, j, None),
+                            evidence={"wall_s": round(wall, 6)})
         if slow:
             self._strike(j, "slow")
         return slow
@@ -495,6 +526,12 @@ class ReplicatedShard:
                     # an all-dead call raises and must not count
                     _c_failovers().inc(len(tried) - 1, name=self._name)
                 _c_reads().inc(1, name=self._name, replica=f"r{j}")
+            if len(tried) > 1:
+                obs_events.emit(
+                    "replica_failover",
+                    subject=("replica", self._name, j, None),
+                    evidence={"retried": len(tried) - 1,
+                              "error": repr(last_exc)})
             requestlog.annotate("replica", j)
             return out
 
@@ -659,6 +696,10 @@ class ReplicatedShard:
                     h.last_error = e
                 if metrics._enabled:
                     _c_fenced().inc(1, name=self._name, reason="write")
+                obs_events.emit(
+                    "replica_stale",
+                    subject=("replica", self._name, j, None),
+                    evidence={"op": op, "error": repr(e)})
         with self._hlock:
             self._update_health_gauges()
         if ok == 0 and last is not None:

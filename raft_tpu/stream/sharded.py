@@ -96,6 +96,7 @@ import numpy as np
 from ..core.errors import expects
 from ..core.resources import default_resources
 from ..obs import dispatch as obs_dispatch
+from ..obs import events as obs_events
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..testing import faults
@@ -1007,6 +1008,11 @@ class ShardedMutableIndex:
             if metrics._enabled:
                 _c_migrations().inc(1, name=self._name, action=action,
                                     phase="started")
+            obs_events.emit(
+                "reshard_started",
+                subject=("reshard", self._name, None,
+                         self._topology_epoch),
+                evidence={"action": action, "from": S, "to": target})
             t0 = time.perf_counter()
             with self._lock:
                 self._migration = {"action": action, "from": S,
@@ -1123,6 +1129,12 @@ class ShardedMutableIndex:
                     _c_rows_moved().inc(rows_moved, name=self._name)
                     _h_reshard().observe(time.perf_counter() - t0,
                                          name=self._name, action=action)
+                obs_events.emit(
+                    "reshard_committed",
+                    subject=("reshard", self._name, None,
+                             step.get("epoch")),
+                    evidence={"action": action, "rows_moved": rows_moved,
+                              "carried_over": step.get("carried_over")})
                 step["wall_s"] = round(time.perf_counter() - t0, 3)
                 return step
             finally:
@@ -1233,7 +1245,17 @@ class ShardedMutableIndex:
                         if sh._wal is not None:
                             sh._wal.close()
                             sh._wal = None
+                obs_events.emit(
+                    "reshard_aborted", severity="error",
+                    subject=("reshard", self._name, None, new_epoch - 1),
+                    evidence={"action": action, "rolled_back_to":
+                              new_epoch - 1})
                 raise
+            obs_events.emit(
+                "reshard_flip",
+                subject=("reshard", self._name, None, new_epoch),
+                evidence={"action": action, "shards": target,
+                          "carried_over": carried})
             self._update_gauges()
         # off the write lock: donor retirement and the old epoch's files —
         # the manifest is durable, nothing references them anymore

@@ -80,6 +80,7 @@ import numpy as np
 from ..core.errors import expects
 from ..core.resources import default_resources
 from ..obs import dispatch as obs_dispatch
+from ..obs import events as obs_events
 from ..obs import mem as obs_mem
 from ..obs import metrics
 from ..testing import faults
@@ -556,8 +557,10 @@ class TieredStore:
             self._promotes += 1
             self._events.append({"event": "promote", "reason": reason,
                                  "at": round(self._clock(), 3)})
-            if metrics._enabled:
-                _c_promotes().inc(1, name=self._name)
+            obs_events.emit(
+                "tier_promote", subject=("tier", self._name, None, None),
+                evidence={"reason": reason, "bytes": self.row_bytes},
+                counter=_c_promotes, counter_labels={"name": self._name})
         finally:
             with self._lock:
                 self._promoting = False
@@ -593,8 +596,15 @@ class TieredStore:
         self._spills += 1
         self._events.append({"event": "spill", "reason": reason,
                              "at": round(self._clock(), 3)})
-        if metrics._enabled:
-            _c_spills().inc(1, name=self._name, reason=reason)
+        obs_events.emit(
+            "tier_spill",
+            # a pressure spill is the budget gate reclaiming HBM —
+            # operator-visible; an explicit/idle spill is routine
+            severity="warning" if reason == "pressure" else "info",
+            subject=("tier", self._name, None, None),
+            evidence={"reason": reason, "freed_bytes": freed},
+            counter=_c_spills,
+            counter_labels={"name": self._name, "reason": reason})
         self._reaccount()
         self._publish_gauges()
         return freed

@@ -51,6 +51,7 @@ import numpy as np
 
 from ..core import serialize
 from ..core.errors import RaftError, expects
+from ..obs import events as obs_events
 from ..obs import metrics
 from ..testing import faults
 
@@ -295,6 +296,7 @@ class WriteAheadLog:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            dropped = self._size
             self._f = open(self.path, "ab")
             self._pending = 0
             self._size = 0
@@ -302,6 +304,10 @@ class WriteAheadLog:
             if metrics._enabled:
                 _c_truncations().inc(1, name=self.name)
                 self._set_size_gauge()
+        obs_events.emit("wal_truncated",
+                        subject=("wal", self.name, None, None),
+                        evidence={"dropped_bytes": dropped,
+                                  "path": self.path})
 
     def close(self) -> None:
         with self._lock:
@@ -365,3 +371,11 @@ class WriteAheadLog:
                 f"{self.last_scan['records']} intact ones")
         if n and metrics._enabled:
             _c_replayed().inc(n, name=self.name)
+        if n:
+            obs_events.emit(
+                "wal_recovered",
+                severity="warning" if self.last_scan["corrupt"] else "info",
+                subject=("wal", self.name, None, None),
+                evidence={"replayed": n,
+                          "torn_tail": self.last_scan["torn"],
+                          "corrupt": self.last_scan["corrupt"]})

@@ -798,6 +798,10 @@ def test_fault_smoke_row():
     assert row["compile_s_loaded"] == 0.0, row
     assert row["recovery_s"] > 0, row
     assert row["qps"] > 0 and row["replicas"] == 2, row
+    # the event plane saw the fence and the heal (ISSUE 17): the row
+    # carries per-kind counts, gated by compare.py on presence
+    assert row["events"]["replica_fenced"] >= 1, row
+    assert row["events"]["replica_unfenced"] >= 1, row
 
 
 def test_crash_recovery_row():
@@ -856,6 +860,10 @@ def test_reshard_churn_row():
     assert row["crash_recovery_s"] > 0, row
     assert row["wal_records_replayed"] > 0, row
     assert row["qps"] > 0 and row["replicas"] == 2, row
+    # the event plane saw the migration and the mid-flight kill (ISSUE 17)
+    assert row["events"]["reshard_started"] >= 1, row
+    assert row["events"]["reshard_flip"] >= 1, row
+    assert row["events"]["replica_fenced"] >= 1, row
 
 
 def test_reshard_flag_runs_only_the_reshard_row(monkeypatch):
@@ -1006,6 +1014,9 @@ def test_tiered_row():
     assert row["tier_bytes"]["host"] == row["store_bytes"]
     assert row["h2d_bytes"] > 0 and row["host_hop_s"] >= 0.0
     assert row["qps"] > 0 and row["qps_hbm"] > 0
+    # the row carries the journal's per-kind counts (ISSUE 17) — present
+    # whenever metrics are on, gated by compare.py on presence
+    assert isinstance(row.get("events"), dict), row
 
 
 def test_tiered_flag_runs_only_the_tiered_row(monkeypatch):
@@ -1059,6 +1070,39 @@ def test_compare_gates_lost_tier_measurement():
                    for r in out["rows"] for c in r["checks"]), out
     # tiers the NEW artifact gained gate nothing
     assert compare.compare(_artifact([{"name": "t", "qps": 1.0}]),
+                           old)["regressions"] == []
+
+
+def test_compare_gates_lost_event_measurement():
+    """The per-kind ``events`` sub-fields (ISSUE 17) gate like the
+    per-tier mem sub-fields on PRESENCE: an event kind the old artifact
+    observed and the new lost must FAIL (a fence window that stops
+    producing replica_fenced events is a lost measurement), while count
+    drift between runs gates nothing."""
+    sys.path.insert(0, str(REPO / "bench"))
+    import compare
+
+    old = _artifact([
+        {"name": "f", "qps": 100.0,
+         "events": {"replica_fenced": 1, "replica_unfenced": 1}},
+    ])
+    drifted = _artifact([
+        {"name": "f", "qps": 100.0,
+         "events": {"replica_fenced": 7, "replica_unfenced": 3}},
+    ])
+    assert compare.compare(old, drifted)["regressions"] == [], (
+        "count drift must not gate — presence does")
+    for lost in (
+        {"events": {"replica_fenced": 1}},   # unfenced kind gone
+        {},                                  # events field gone
+    ):
+        new = _artifact([{"name": "f", "qps": 100.0, **lost}])
+        out = compare.compare(old, new)
+        assert out["regressions"] == ["f"], lost
+        assert any(c.get("missing") and c["field"].startswith("events.")
+                   for r in out["rows"] for c in r["checks"]), out
+    # kinds the NEW artifact gained gate nothing
+    assert compare.compare(_artifact([{"name": "f", "qps": 1.0}]),
                            old)["regressions"] == []
 
 

@@ -1,0 +1,603 @@
+"""Unified operations event plane (ISSUE 17, tier-1 ``events`` marker).
+
+The journal's contracts, each deterministic — injected clocks, threaded
+emitters without wall sleeps, faults via :mod:`raft_tpu.testing.faults`:
+
+- strictly increasing sequence numbers under concurrent emitters;
+- bounded-ring eviction with eviction-proof cumulative per-kind counts;
+- ``since_seq`` pagination (exclusive cursor — no gaps, no repeats);
+- subscriber taps (in-order delivery, unsubscribe, a raising tap never
+  breaks the emitter);
+- the durable JSONL sink (atomic rotation, torn-tail tolerant reload);
+- the disabled fast path (one flag check: the injected clock is never
+  read, nothing lands anywhere);
+- the drift → pressure-spill → fence → reshard-advice causal chain read
+  back as one ordered timeline, and the same filters over HTTP at
+  ``/debug/events``;
+- the incident flight recorder (SLO ``failing`` → complete bundle,
+  rate-limited on the journal clock);
+- per-call-site log/metric/journal consistency: one emit carries all
+  three, so they cannot disagree on re-arm paths.
+"""
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import events, metrics, requestlog, slo
+
+pytestmark = pytest.mark.events
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal():
+    """Every test runs against its OWN process journal (small, injected
+    clock available via reconfigure) and leaves obs enabled."""
+    obs.enable()
+    events.configure(capacity=2048)
+    yield
+    events.detach_sink()
+    events.disarm_flight_recorder()
+    events.configure(capacity=2048)
+    obs.enable()
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# journal core
+# ---------------------------------------------------------------------------
+
+
+class TestJournalCore:
+    def test_emit_shape_metric_and_request_id(self):
+        before = obs.to_json()
+        ev = events.emit("tier_spill", subject=("tier", "s", 3, 7),
+                         evidence={"reason": "pressure"},
+                         request_id="req-00000042")
+        assert ev["kind"] == "tier_spill"
+        assert ev["severity"] == "info"  # KINDS default
+        assert (ev["component"], ev["name"], ev["shard"], ev["epoch"]) \
+            == ("tier", "s", 3, 7)
+        assert ev["request_id"] == "req-00000042"
+        assert ev["seq"] == events.last_seq()
+        d = obs.delta(before, obs.to_json())
+        assert d.get('raft_tpu_events_total'
+                     '{kind="tier_spill",severity="info"}') == 1
+        # severity override lands in both the event and the metric label
+        ev2 = events.emit("tier_spill", severity="warning",
+                          subject=("tier", "s"))
+        assert ev2["severity"] == "warning" and ev2["seq"] == ev["seq"] + 1
+
+    def test_unknown_kind_and_severity_rejected(self):
+        with pytest.raises(ValueError, match="unknown event kind"):
+            events.emit("not_a_kind", subject=("x", "y"))
+        with pytest.raises(ValueError, match="unknown severity"):
+            events.emit("tier_spill", severity="fatal")
+
+    def test_concurrent_emitters_strictly_increasing_seq(self):
+        j = events.EventJournal(capacity=4096)
+        n_threads, per = 8, 50
+        start = threading.Barrier(n_threads)
+
+        def worker(i):
+            start.wait()
+            for _ in range(per):
+                j.emit("replica_probe", subject=("replica", f"t{i}", i))
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        evs = j.query()
+        seqs = [e["seq"] for e in evs]
+        assert len(seqs) == n_threads * per
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert seqs[-1] == j.last_seq() == n_threads * per
+        assert j.counts_by_kind() == {"replica_probe": n_threads * per}
+
+    def test_ring_eviction_keeps_cumulative_counts(self):
+        j = events.EventJournal(capacity=8)
+        for i in range(20):
+            j.emit("wal_truncated", subject=("wal", "w"),
+                   evidence={"i": i})
+        kept = j.tail(100)
+        assert len(kept) == 8  # ring bound holds
+        assert [e["seq"] for e in kept] == list(range(13, 21))
+        assert j.last_seq() == 20
+        # attribution survives eviction: the bench field reads this
+        assert j.counts_by_kind() == {"wal_truncated": 20}
+
+    def test_since_seq_pagination_no_gaps_no_repeats(self):
+        j = events.EventJournal(capacity=64)
+        for i in range(30):
+            j.emit("serve_published", subject=("serve", "s", None, i))
+        seen, cursor = [], 0
+        while True:
+            page = j.query(since_seq=cursor, limit=7)
+            if not page:
+                break
+            seen.extend(e["seq"] for e in page)
+            cursor = page[-1]["seq"]  # the exclusive cursor contract
+        assert seen == list(range(1, 31))
+
+    def test_query_filters(self):
+        j = events.EventJournal(capacity=64)
+        j.emit("tier_spill", subject=("tier", "a"))
+        j.emit("tier_spill", severity="warning", subject=("tier", "b"))
+        j.emit("replica_fenced", subject=("replica", "a", 0))
+        assert [e["name"] for e in j.query(kind="tier_spill")] == ["a", "b"]
+        assert [e["kind"] for e in j.query(component="tier")] \
+            == ["tier_spill", "tier_spill"]
+        assert [e["kind"] for e in j.query(name="a")] \
+            == ["tier_spill", "replica_fenced"]
+        # seq 3 rides along: replica_fenced defaults to warning in KINDS
+        assert [e["seq"] for e in j.query(severity="warning")] == [2, 3]
+
+    def test_taps_in_order_unsubscribe_and_raising_tap(self):
+        j = events.EventJournal(capacity=64)
+        seen: list = []
+        j.subscribe(seen.append)
+
+        def bad(ev):
+            raise RuntimeError("tap must never break the emitter")
+
+        j.subscribe(bad)
+        for i in range(5):
+            assert j.emit("replica_probe", subject=("replica", "g", i)) \
+                is not None  # the raising tap was swallowed
+        assert [e["seq"] for e in seen] == [1, 2, 3, 4, 5]
+        j.unsubscribe(seen.append)
+        j.emit("replica_probe", subject=("replica", "g", 9))
+        assert len(seen) == 5  # unsubscribed: no more deliveries
+
+    def test_transition_dedup_and_standing_payload(self):
+        j = events.EventJournal()
+        k = ("adv", 0)
+        assert j.transition(k, None) is False  # vacuous first clear
+        assert j.transition(k, "split:4", {"action": "split"}) is True
+        assert j.transition_payload(k) == {"action": "split"}
+        assert j.transition(k, "split:4", {"action": "split"}) is False
+        assert j.transition(k, None) is True  # clearing IS a transition
+        assert j.transition_payload(k) is None
+        assert j.transition(k, "merge:2", {"action": "merge"}) is True
+        # dedup state is NOT obs-gated: standing advisories answer
+        # correctly even while the observable surface is off
+        obs.disable()
+        try:
+            assert j.transition(k, "merge:2") is False
+            assert j.transition_payload(k) == {"action": "merge"}
+        finally:
+            obs.enable()
+
+    def test_clear_keeps_seq_monotonic(self):
+        j = events.EventJournal()
+        j.emit("wal_recovered", subject=("wal", "w"))
+        j.clear()
+        assert j.tail(10) == [] and j.counts_by_kind() == {}
+        ev = j.emit("wal_recovered", subject=("wal", "w"))
+        assert ev["seq"] == 2  # a since_seq cursor never sees a restart
+
+
+# ---------------------------------------------------------------------------
+# disabled fast path
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledPath:
+    def test_disabled_emit_is_one_flag_check(self):
+        clk = FakeClock()
+        events.configure(capacity=64, clock=clk)
+        events.emit("tier_promote", subject=("tier", "t"))
+        reads_enabled = clk.reads
+        assert reads_enabled >= 1 and events.last_seq() == 1
+        obs.disable()
+        try:
+            before = obs.to_json()
+            assert events.emit("tier_promote", subject=("tier", "t")) is None
+            assert clk.reads == reads_enabled  # clock never read
+            assert events.last_seq() == 1      # nothing appended
+            assert events.counts_by_kind() == {"tier_promote": 1}
+            assert obs.delta(before, obs.to_json()) == {}
+        finally:
+            obs.enable()
+        # re-enable: sequence resumes where it left off
+        assert events.emit("tier_promote",
+                           subject=("tier", "t"))["seq"] == 2
+
+    def test_disabled_emit_skips_taps_and_sink(self, tmp_path):
+        p = str(tmp_path / "sink.jsonl")
+        events.attach_sink(p)
+        seen: list = []
+        events.subscribe(seen.append)
+        obs.disable()
+        try:
+            events.emit("tier_spill", subject=("tier", "t"))
+        finally:
+            obs.enable()
+        events.detach_sink()
+        assert seen == [] and events.load_jsonl(p) == []
+
+
+# ---------------------------------------------------------------------------
+# durable JSONL sink
+# ---------------------------------------------------------------------------
+
+
+class TestSink:
+    def test_sink_rotation_and_reload(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        j = events.EventJournal(capacity=64)
+        j.attach_sink(p, rotate_bytes=600)
+        for i in range(12):
+            j.emit("serve_retired", subject=("serve", "s", None, i))
+        j.detach_sink()
+        assert (tmp_path / "events.jsonl.1").exists(), \
+            "the sink must have rotated at the size bound"
+        old = events.load_jsonl(p + ".1")
+        new = events.load_jsonl(p)
+        assert old  # at least one rotated generation landed
+        seqs = [e["seq"] for e in old + new]
+        # one rotated generation + the live file hold a contiguous,
+        # gapless suffix ending at the newest event
+        assert len(seqs) >= 4 and seqs == list(range(seqs[0], 13))
+        assert all(e["kind"] == "serve_retired" for e in old + new)
+
+    def test_torn_tail_reload(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        j = events.EventJournal(capacity=64)
+        j.attach_sink(p)
+        for i in range(4):
+            j.emit("wal_truncated", subject=("wal", "w"),
+                   evidence={"i": i})
+        j.detach_sink()
+        with open(p, "ab") as f:
+            f.write(b'{"seq": 99, "kind": "wal_trunc')  # crash mid-append
+        back = events.load_jsonl(p)
+        assert [e["evidence"]["i"] for e in back] == [0, 1, 2, 3]
+        assert events.load_jsonl(str(tmp_path / "missing.jsonl")) == []
+
+    def test_sink_survives_write_failure(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        j = events.EventJournal(capacity=64)
+        j.attach_sink(p)
+        j.emit("wal_truncated", subject=("wal", "w"))
+        j._sink_f.close()  # simulate the descriptor dying (EIO/ENOSPC)
+        ev = j.emit("wal_truncated", subject=("wal", "w"))
+        assert ev is not None and ev["seq"] == 2  # emitter survives
+        assert j._sink_f is None  # sink detached itself
+        assert len(events.load_jsonl(p)) == 1
+
+
+# ---------------------------------------------------------------------------
+# the causal chain: drift -> pressure spill -> fence -> reshard advice
+# ---------------------------------------------------------------------------
+
+
+def _heavytail_rows():
+    from raft_tpu.tune.reference import _clustered
+
+    x, _ = _clustered(2000, 32, 8, 64, seed=29, heavytail=True)
+    return np.asarray(x)
+
+
+class TestCausalChain:
+    def test_injected_scenario_reads_as_one_ordered_timeline(self, rng):
+        """The acceptance scenario: four independent subsystems misbehave
+        in a known order; the journal replays them as ONE causally
+        ordered timeline — strictly increasing seq, each event
+        attributed to its subject."""
+        import jax.numpy as jnp
+
+        from raft_tpu import stream
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.obs import quality
+        from raft_tpu.testing import faults
+        from raft_tpu.tune import shape_family
+
+        clk = FakeClock()
+        data = rng.standard_normal((256, 16)).astype(np.float32)
+        queries = rng.standard_normal((4, 16)).astype(np.float32)
+
+        # 1) family drift fires retune_advised
+        det = quality.DriftDetector(shape_family(2000, 32, "bal"),
+                                    name="evt-drift", min_rows=128)
+        det.offer_rows(_heavytail_rows()[:512])
+        assert det.check()["drifted"]
+
+        # 2) a budget squeeze spills the tier mirror
+        ts = stream.TieredStore(data, name="evt-tier")
+        assert ts.promote(force=True)
+        ts.spill(reason="pressure")
+
+        # 3) an injected replica fault fences a twin
+        g = stream.ReplicatedShard(
+            brute_force.BruteForce().build(jnp.asarray(data)),
+            n_replicas=2, delta_capacity=64,
+            policy=stream.FencingPolicy(max_consecutive=1, backoff_s=5.0),
+            clock=clk, name="evt-g")
+        with faults.scope():
+            # whichever replica the pick lands on dies once: the failover
+            # serves the query and the breaker fences the struck twin
+            faults.inject("replica/search", exc=faults.FaultError("dead"),
+                          times=1)
+            g.search(queries, 5)
+
+        # 4) the compactor's watermark advises a split
+        sm = stream.ShardedMutableIndex(
+            data, n_shards=2, delta_capacity=32, clock=clk,
+            name="evt-mesh",
+            build=lambda r: brute_force.BruteForce().build(jnp.asarray(r)))
+        comp = stream.Compactor(
+            sm, policy=stream.CompactionPolicy(
+                delta_fill=None, tombstone_ratio=None,
+                reshard_rows_per_shard=100),
+            clock=clk)
+        comp.run_once()
+        assert comp.last_advice is not None
+
+        timeline = events.query()
+        seqs = [e["seq"] for e in timeline]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        by_kind = {e["kind"]: e for e in timeline}
+        chain = ["retune_advised", "tier_spill", "replica_fenced",
+                 "reshard_advised"]
+        assert all(k in by_kind for k in chain), sorted(by_kind)
+        assert [by_kind[k]["seq"] for k in chain] \
+            == sorted(by_kind[k]["seq"] for k in chain), \
+            "journal order must match the injection order"
+        # each event is attributed to its subject
+        drift = by_kind["retune_advised"]
+        assert (drift["component"], drift["name"]) == ("quality",
+                                                       "evt-drift")
+        spill = by_kind["tier_spill"]
+        assert (spill["component"], spill["name"]) == ("tier", "evt-tier")
+        assert spill["severity"] == "warning"  # pressure escalates
+        assert spill["evidence"]["reason"] == "pressure"
+        fence = by_kind["replica_fenced"]
+        assert (fence["component"], fence["name"]) == ("replica", "evt-g")
+        assert fence["shard"] in (0, 1)
+        assert "FaultError" in fence["evidence"]["error"]
+        adv = by_kind["reshard_advised"]
+        assert (adv["component"], adv["name"]) == ("compactor", "evt-mesh")
+        assert adv["evidence"]["action"] == "split"
+        # the same chain, filtered server-side over HTTP
+        with obs.MetricsExporter(port=0) as exp:
+            base = f"http://127.0.0.1:{exp.port}"
+            code, body = _get(base + "/debug/events"
+                              f"?since_seq={drift['seq']}")
+            assert code == 200
+            payload = json.loads(body)
+            assert [e["kind"] for e in payload["events"]
+                    if e["kind"] in chain] == chain[1:]
+            code, body = _get(base + "/debug/events?component=replica"
+                              "&severity=warning")
+            assert code == 200
+            got = json.loads(body)["events"]
+            assert got and all(e["component"] == "replica"
+                               and e["severity"] == "warning" for e in got)
+
+
+# ---------------------------------------------------------------------------
+# /debug/events HTTP contract
+# ---------------------------------------------------------------------------
+
+
+class TestHttpEndpoint:
+    def test_filters_pagination_and_404_list(self):
+        for i in range(5):
+            events.emit("serve_published", subject=("serve", "svc", None, i))
+        events.emit("budget_refusal", subject=("mem", "site"))
+        with obs.MetricsExporter(port=0) as exp:
+            base = f"http://127.0.0.1:{exp.port}"
+            code, body = _get(base + "/debug/events")
+            assert code == 200
+            payload = json.loads(body)
+            assert payload["last_seq"] == 6
+            assert payload["counts_by_kind"] == {"serve_published": 5,
+                                                 "budget_refusal": 1}
+            code, body = _get(base + "/debug/events?kind=serve_published"
+                              "&since_seq=2&limit=2")
+            evs = json.loads(body)["events"]
+            assert [e["seq"] for e in evs] == [3, 4]
+            code, body = _get(base + "/debug/events?severity=error")
+            assert [e["kind"] for e in json.loads(body)["events"]] \
+                == ["budget_refusal"]
+            code, body = _get(base + "/debug/events?since_seq=oops")
+            assert code == 400
+            code, body = _get(base + "/nope")
+            assert code == 404 and "/debug/events" in body
+
+
+# ---------------------------------------------------------------------------
+# incident flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_slo_failing_writes_complete_bundle(self, tmp_path):
+        clk = FakeClock()
+        events.configure(capacity=256, clock=clk)
+        rl = requestlog.RequestLog(clock=clk)
+        rid = rl.begin("s", 1)
+        rl.complete(rid, stream="s", rows=1,
+                    spans={"queue": 0.001, "flush": 0.002})
+        events.arm_flight_recorder(str(tmp_path), request_log=rl,
+                                   min_interval_s=300.0, window=4)
+        for i in range(6):  # context the bundle window should carry
+            events.emit("replica_probe", subject=("replica", "g", i % 2))
+        tracker = slo.SLOTracker(slo.SLOPolicy(failing_burn=5.0),
+                                 name="evt-slo", clock=clk)
+        for _ in range(50):
+            tracker.record_admission(False)
+        assert tracker.status() == "failing"  # transition -> auto bundle
+        bundles = sorted(p for p in tmp_path.iterdir() if p.is_dir())
+        assert len(bundles) == 1
+        b = bundles[0]
+        assert b.name.endswith("-slo_failing")
+        for fname in ("events.json", "mem.json", "requests.json",
+                      "metrics.json", "meta.json"):
+            assert (b / fname).exists(), fname
+        window = json.loads((b / "events.json").read_text())
+        assert len(window) == 4  # the armed window bound
+        assert window[-1]["kind"] == "slo_verdict"
+        assert window[-1]["evidence"]["status"] == "failing"
+        reqs = json.loads((b / "requests.json").read_text())
+        assert reqs["recent"][0]["rid"] == rid
+        meta = json.loads((b / "meta.json").read_text())
+        assert meta["reason"] == "slo_failing"
+        # the recorder leaves its breadcrumb in the journal
+        crumbs = events.query(kind="flight_recorder")
+        assert len(crumbs) == 1
+        assert crumbs[0]["evidence"]["dir"] == str(b)
+
+    def test_rate_limit_and_explicit_snapshot(self, tmp_path):
+        clk = FakeClock()
+        events.configure(capacity=64, clock=clk)
+        events.arm_flight_recorder(str(tmp_path), min_interval_s=300.0)
+        events.emit("wal_recovered", subject=("wal", "w"))
+        assert events.snapshot("first", force=False) is not None
+        # inside the interval: the auto path (force=False) is suppressed
+        clk.advance(10.0)
+        assert events.snapshot("second", force=False) is None
+        # the explicit operator trigger bypasses the limit
+        d = events.snapshot("manual")
+        assert d is not None and d.endswith("-manual")
+        # past the interval the auto path fires again
+        clk.advance(400.0)
+        assert events.snapshot("third", force=False) is not None
+        assert len([p for p in tmp_path.iterdir() if p.is_dir()]) == 3
+
+    def test_snapshot_without_recorder_armed(self, tmp_path):
+        import os
+
+        assert events.snapshot("nowhere") is None  # no dir: skipped
+        d = events.snapshot("adhoc", dir_=str(tmp_path))
+        assert d is not None and d.endswith("-adhoc")
+        assert os.path.exists(os.path.join(d, "events.json"))
+
+
+# ---------------------------------------------------------------------------
+# call-site consistency: one emit = log + metric + journal (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+class TestCallSiteConsistency:
+    def test_drift_site_log_metric_journal_agree(self, caplog):
+        from raft_tpu.obs import quality
+        from raft_tpu.tune import shape_family
+
+        before = obs.to_json()
+        det = quality.DriftDetector(shape_family(2000, 32, "bal"),
+                                    name="evt-agree", min_rows=128)
+        det.offer_rows(_heavytail_rows()[:512])
+        with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+            det.check()
+            det.check()  # standing drift: no re-emit anywhere
+        warns = [r for r in caplog.records
+                 if "family drift on 'evt-agree'" in r.getMessage()]
+        journal = [e for e in events.query(kind="retune_advised")
+                   if e["name"] == "evt-agree"]
+        d = obs.delta(before, obs.to_json())
+        counted = d.get(
+            'raft_tpu_quality_retune_advised_total{name="evt-agree"}', 0)
+        assert len(warns) == len(journal) == counted == 1, (
+            "the WARNING, the counter and the journal entry must move "
+            f"together: log={len(warns)} journal={len(journal)} "
+            f"metric={counted}")
+        # the legacy view is the journal, reshaped
+        assert det.events[0]["event"] == "retune_advised"
+        assert det.events[0]["auto_apply"] is False
+        assert journal[0]["evidence"]["observed"].endswith("-skew")
+
+    def test_compactor_site_rearm_paths_agree(self, caplog, rng):
+        import jax.numpy as jnp
+
+        from raft_tpu import stream
+        from raft_tpu.neighbors import brute_force
+
+        data = rng.standard_normal((256, 16)).astype(np.float32)
+        clk = FakeClock()
+        sm = stream.ShardedMutableIndex(
+            data, n_shards=2, delta_capacity=32, clock=clk, name="evt-adv",
+            build=lambda r: brute_force.BruteForce().build(jnp.asarray(r)))
+        comp = stream.Compactor(
+            sm, policy=stream.CompactionPolicy(
+                delta_fill=None, tombstone_ratio=None,
+                reshard_rows_per_shard=100),
+            clock=clk)
+        before = obs.to_json()
+        with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+            comp.run_once()
+            comp.run_once()  # standing advice: no re-emit anywhere
+        assert comp.last_advice["action"] == "split"  # journal-backed view
+        warns = [r for r in caplog.records
+                 if "reshard advised" in r.getMessage()]
+        journal = events.query(kind="reshard_advised", name="evt-adv")
+        counted = obs.delta(before, obs.to_json()).get(
+            'raft_tpu_reshard_advised_total{action="split",name="evt-adv"}',
+            0)
+        assert len(warns) == len(journal) == counted == 1
+        # acting on the advice clears it: the clear is itself journaled
+        sm.reshard(4)
+        comp.run_once()
+        assert comp.last_advice is None
+        cleared = events.query(kind="reshard_advice_cleared",
+                               name="evt-adv")
+        assert len(cleared) == 1
+        assert cleared[0]["seq"] > journal[0]["seq"]
+        # fold lifecycle rides the same journal
+        kinds = {e["kind"] for e in events.query(component="compactor")}
+        assert {"reshard_advised", "reshard_advice_cleared"} <= kinds
+
+    def test_mem_refusal_site_metric_and_journal_agree(self):
+        from raft_tpu.core import Resources
+        from raft_tpu.obs import mem as obs_mem
+        from raft_tpu.serve.errors import MemoryBudgetError
+
+        class Ballast:  # plain object() cannot carry a weakref
+            pass
+
+        ballast = Ballast()
+        tok = obs_mem.account("test/evt", name="ballast", owner=ballast,
+                              device_bytes=1 << 20)
+        try:
+            before = obs.to_json()
+            res = Resources(memory_budget_bytes=1)
+            with pytest.raises(MemoryBudgetError):
+                obs_mem.gate(res, 1 << 20, site="evt-site")
+            journal = events.query(kind="budget_refusal", name="evt-site")
+            counted = obs.delta(before, obs.to_json()).get(
+                'raft_tpu_mem_budget_refusals_total{site="evt-site"}', 0)
+            assert len(journal) == counted == 1
+            assert journal[0]["evidence"]["need_bytes"] == 1 << 20
+            assert journal[0]["severity"] == "error"
+        finally:
+            obs_mem.retire(tok)

@@ -63,3 +63,92 @@ def test_scan_is_not_vacuous():
                  "raft_tpu_tune_trials_total",
                  "raft_tpu_compile_cache_total"):
         assert name in src and name in doc, name
+
+
+# ---------------------------------------------------------------------------
+# event-kind catalogue (ISSUE 17 satellite): KINDS <-> docs, both ways
+# ---------------------------------------------------------------------------
+
+# a documented kind row between the markers: "| `kind` | `severity` | ..."
+_KIND_ROW = re.compile(
+    r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`?(info|warning|error)`?", re.M)
+# a literal emit call site: emit("kind" / obs_events.emit(\n    "kind"
+_EMIT_SITE = re.compile(r'\bemit\(\s*\n?\s*"([a-z0-9_]+)"')
+
+
+def _documented_kinds() -> dict:
+    text = DOC.read_text()
+    start = text.index("<!-- event-kind-catalogue:start -->")
+    end = text.index("<!-- event-kind-catalogue:end -->")
+    return dict(_KIND_ROW.findall(text[start:end]))
+
+
+def _source_kinds() -> dict:
+    from raft_tpu.obs.events import KINDS
+
+    return dict(KINDS)
+
+
+def test_every_event_kind_is_documented():
+    src, doc = _source_kinds(), _documented_kinds()
+    undocumented = set(src) - set(doc)
+    assert not undocumented, (
+        "event kinds in raft_tpu.obs.events.KINDS but missing from the "
+        f"docs/observability.md kind catalogue: {sorted(undocumented)}")
+    wrong = {k for k in src if doc[k] != src[k]}
+    assert not wrong, (
+        "documented default severity disagrees with KINDS for: "
+        f"{sorted(wrong)}")
+
+
+def test_every_documented_event_kind_exists():
+    stale = set(_documented_kinds()) - set(_source_kinds())
+    assert not stale, (
+        "docs/observability.md catalogues event kinds KINDS no longer "
+        f"defines: {sorted(stale)}")
+
+
+def test_every_event_kind_has_a_literal_emit_site():
+    """Every kind in the catalogue is actually emitted somewhere, with a
+    literal kind string (same grepability convention as metric names).
+    ``flight_recorder`` is the journal's own breadcrumb — its emit site
+    lives in events.py itself and counts like any other."""
+    sites = set()
+    for path in sorted((REPO / "raft_tpu").rglob("*.py")):
+        sites.update(_EMIT_SITE.findall(path.read_text()))
+    dead = set(_source_kinds()) - sites
+    assert not dead, (
+        f"KINDS entries with no literal emit(...) call site: {sorted(dead)}"
+        " — either wire the call site or drop the kind")
+
+
+def test_kind_scan_is_not_vacuous():
+    src, doc = _source_kinds(), _documented_kinds()
+    assert len(src) >= 20 and len(doc) >= 20, (len(src), len(doc))
+    for kind in ("retune_advised", "reshard_advised", "replica_fenced",
+                 "slo_verdict"):
+        assert kind in src and kind in doc, kind
+
+
+def test_advisory_and_transition_metrics_ride_the_journal():
+    """ISSUE 17 satellite: every file registering an advisory/transition
+    metric (``raft_tpu_*_advised*``, fence/failover/spill/refusal
+    counters) must emit through the unified journal — a new advisory
+    surface cannot ship outside the event plane."""
+    transition_pat = re.compile(
+        r"raft_tpu_[a-z0-9_]*(?:_advised|_fenced|_failovers?|_refusals?|"
+        r"_spills?|_truncations?)_total")
+    offenders = []
+    for path in sorted((REPO / "raft_tpu").rglob("*.py")):
+        text = path.read_text()
+        if transition_pat.search(text) and "obs_events.emit(" not in text \
+                and path.name != "events.py":
+            offenders.append(str(path.relative_to(REPO)))
+    assert not offenders, (
+        "files register advisory/transition metrics but never emit to "
+        f"the event journal: {offenders}")
+    # not vacuous: the known advisory sites must be in scope of the scan
+    scanned = {p.name for p in (REPO / "raft_tpu").rglob("*.py")
+               if transition_pat.search(p.read_text())}
+    assert {"quality.py", "compactor.py", "replicated.py"} <= scanned, \
+        sorted(scanned)
