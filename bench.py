@@ -2500,6 +2500,288 @@ def _row_reshard_churn(rows, n=100_000, d=64, n_lists=512, k=10,
     rows.append(row)
 
 
+def _row_controller_drift(rows, n=100_000, d=64, ncl=256, n_lists=256,
+                          k=10, m=512, n_eval=256, qbatch=64, repeats=1):
+    """Self-driving retune proof (ISSUE 18): a heavytail corpus serves
+    under a deliberately-collapsed operating point (``n_probes=1``), the
+    drift detector's ``retune_advised`` sensor event reaches the
+    controller through the journal tap, and the controller runs its
+    bounded sweep and republishes ``tuned=`` through the registry's
+    warm-before-flip seam. Asserted:
+
+    - **recall recovers**: ``recall_recovered`` (post-retune, gated by
+      bench/compare.py) beats the collapsed pre-retune point (recorded as
+      ``pre_retune_at_k`` — deliberately NOT a ``recall*`` field: it is
+      low by construction and must not be gated upward);
+    - **zero failed queries** across the flip — the old version serves
+      until the tuned successor is warm;
+    - **zero cold compiles** over the measured window (rehearsal
+      protocol: the identical sense→decide→actuate schedule replays
+      against a fresh registry/controller with every program warm);
+    - **the causal seq chain** sensor → ``control/decision`` →
+      ``control/action_completed`` → ``serve_published`` holds in the
+      journal, with the decision/trigger seqs cross-referenced — the
+      whole actuation replays from the journal alone.
+    """
+    import numpy as np
+
+    from raft_tpu import tune
+    from raft_tpu.control import ControlPolicy, Controller
+    from raft_tpu.neighbors import brute_force, ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import events as obs_events
+    from raft_tpu.obs import quality
+    from raft_tpu.serve import IndexRegistry
+    from raft_tpu.tune import reference
+
+    ev_before = _events_snap()
+    _note("controller drift: dataset")
+    x, q = reference._clustered(n, d, m, ncl, seed=29, heavytail=True)
+    xq = np.asarray(q)
+    eval_q = xq[:n_eval]
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists, seed=0), x)
+    _, gt_i = brute_force.BruteForce().build(x).search(eval_q, k)
+    gt = np.asarray(gt_i)
+    family = tune.family_of(idx, x)
+    # the collapsed pin: right family (the guard is not what this row
+    # exercises), starved operating point
+    pin = tune.Decision(kind="ivf_flat", dtype="float32", family=family,
+                        params={"n_probes": 1})
+    grid = [{"n_probes": max(n_lists // 8, 2)},
+            {"n_probes": max(n_lists // 4, 4)},
+            {"n_probes": max(n_lists // 2, 8)}]
+
+    def run_window():
+        reg = IndexRegistry(buckets=(qbatch,))
+        reg.publish("drift", idx, tuned=pin, k=(k,), warm_data=x[:1024])
+        ctl = Controller(publisher=reg,
+                         policy=ControlPolicy(retune_cooldown_s=0.0))
+        ctl.watch("drift", idx, xq[:128], dataset=x, k=k, ks=(k,),
+                  grid=grid, repeats=repeats, warm_data=x[:1024],
+                  decision=pin)
+        ctl.arm()
+        det = quality.DriftDetector(tune.shape_family(n, d, "bal"),
+                                    name="drift", min_rows=256)
+        out = {"failed": 0, "served": 0}
+
+        def measure():
+            v = reg.active("drift")
+            hits = 0
+            for b in range(0, n_eval, qbatch):
+                try:
+                    _, ii = v.searcher(eval_q[b:b + qbatch], k)
+                except Exception:
+                    out["failed"] += 1
+                    continue
+                out["served"] += 1
+                for r_, g_ in zip(np.asarray(ii), gt[b:b + qbatch]):
+                    hits += len(set(r_.tolist()) & set(g_.tolist()))
+            return hits / (n_eval * k)
+
+        t0 = time.perf_counter()
+        try:
+            out["pre"] = measure()
+            det.offer_rows(np.asarray(x[:2048]))
+            det.check()          # heavytail vs the "bal" pin -> advised
+            out["handled"] = ctl.step()
+            out["post"] = measure()
+            out["version"] = reg.active("drift").version
+        finally:
+            ctl.disarm()
+        out["wall_s"] = time.perf_counter() - t0
+        return out
+
+    _note("controller drift: rehearsal")
+    run_window()
+
+    _note("controller drift: measured window")
+    with obs_compile.attribution() as rec:
+        out = run_window()
+    assert out["failed"] == 0, (
+        f"{out['failed']} query batches failed across the retune flip")
+    assert out["handled"] == 1, out
+    assert out["version"] == 2, out
+    assert out["post"] > out["pre"], (
+        f"retune did not recover recall: {out['pre']} -> {out['post']}")
+    assert rec.compile_s == 0.0, (
+        f"measured window compiled {rec.compile_s}s after rehearsal — the "
+        "controller's republish minted a cold program on the hot path")
+    # the causal seq chain, straight off the journal (newest = measured run)
+    sensor = obs_events.query(kind="retune_advised", name="drift")[-1]
+    dec = obs_events.query(kind="control/decision", name="drift")[-1]
+    done = obs_events.query(kind="control/action_completed",
+                            name="drift")[-1]
+    pub = obs_events.query(kind="serve_published", name="drift")[-1]
+    assert sensor["seq"] < dec["seq"] < done["seq"], (sensor, dec, done)
+    assert dec["evidence"]["trigger_seq"] == sensor["seq"], dec
+    assert done["evidence"]["decision_seq"] == dec["seq"], done
+    assert pub["evidence"]["cause"]["decision_seq"] == dec["seq"], pub
+
+    row = {
+        "name": "controller_drift_100k", "n": n, "d": d,
+        "queries": out["served"] * qbatch,
+        "failed_queries": out["failed"],
+        "pre_retune_at_k": round(out["pre"], 4),      # collapsed on purpose
+        "recall_recovered": round(out["post"], 4),    # gated by compare.py
+        "retuned_version": out["version"],
+        "trigger_seq": sensor["seq"],
+        "decision_seq": dec["seq"],
+        "compile_s_loaded": rec.compile_s,
+        "wall_s": round(out["wall_s"], 1),
+        "controller_note": "drift sensor -> journal tap -> bounded sweep "
+                           "-> tuned republish through warm-before-flip; "
+                           "recall recovered with zero failed queries and "
+                           "zero cold compiles; decision seq chain "
+                           "asserted from the journal alone",
+    }
+    events = _events_delta(ev_before)   # gated by compare.py on presence
+    if events is not None:
+        row["events"] = events
+    rows.append(row)
+
+
+def _row_controller_ramp(rows, n=100_000, d=64, n_lists=256, k=10,
+                         shards=2, n_probes=16, qbatch=64, n_eval=256,
+                         ramp_steps=8, ramp_rows=512,
+                         delta_capacity=8192):
+    """Self-driving reshard proof (ISSUE 18): an upsert ramp pushes a
+    mesh past the compactor's ``reshard_rows_per_shard`` watermark, the
+    standing ``reshard_advised`` event reaches the controller through the
+    journal tap, and the controller doubles the topology online under its
+    headroom/burn admission (library mode: ``warm_buckets`` pre-warms the
+    successors, so the flip mints no program). Asserted: zero failed
+    queries across the ramp AND the flip, zero cold compiles over the
+    measured window (rehearsal protocol), recall vs the exact mesh oracle
+    held across the flip (``recall_pre``/``recall_post``, gated), and the
+    causal chain sensor → decision → ``reshard_started`` → completed.
+    """
+    import numpy as np
+
+    from raft_tpu import stream
+    from raft_tpu.control import Controller
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import events as obs_events
+
+    import jax
+
+    ev_before = _events_snap()
+    _note("controller ramp: dataset")
+    rng = np.random.default_rng(23)
+    x = rng.random((n, d), np.float32)
+    ramp = rng.random((ramp_steps * ramp_rows, d), np.float32)
+    eval_q = rng.random((n_eval, d), np.float32)
+    nl = max(n_lists // shards, 8)
+    sp = ivf_flat.SearchParams(n_probes=max(n_probes // shards, 1))
+    # the watermark trips mid-ramp: base load sits under it, the ramp
+    # crosses it
+    threshold = (n + ramp_steps * ramp_rows // 2) // shards
+
+    def build(r):
+        return ivf_flat.build(ivf_flat.IndexParams(n_lists=nl, seed=0), r)
+
+    def recall_vs_oracle(sm):
+        _, ia = sm.search(eval_q, k)
+        _, ie = sm.exact_search(eval_q, k)
+        ia, ie = np.asarray(ia), np.asarray(ie)
+        return float(np.mean([len(set(a.tolist()) & set(b.tolist())) / k
+                              for a, b in zip(ia, ie)]))
+
+    def run_window(tag):
+        mesh = stream.ShardedMutableIndex(
+            x, n_shards=shards, build=build, search_params=sp,
+            delta_capacity=delta_capacity, name=f"ramp_{tag}")
+        mesh.warm((qbatch, n_eval), ks=(k,))
+        jax.block_until_ready(mesh.search(eval_q, k))
+        jax.block_until_ready(mesh.exact_search(eval_q, k))
+        comp = stream.Compactor(
+            mesh, policy=stream.CompactionPolicy(
+                delta_fill=None, tombstone_ratio=None,
+                reshard_rows_per_shard=threshold))
+        ctl = Controller()
+        ctl.attach_mesh(mesh, warm_buckets=(qbatch, n_eval), ks=(k,))
+        ctl.attach_compactor(comp)
+        ctl.arm()
+        out = {"failed": 0, "served": 0}
+
+        def serve():
+            for b in range(0, n_eval, qbatch):
+                try:
+                    _, ii = mesh.search(eval_q[b:b + qbatch], k)
+                    assert np.asarray(ii).shape[0] > 0
+                    out["served"] += 1
+                except Exception:
+                    out["failed"] += 1
+
+        t0 = time.perf_counter()
+        try:
+            out["recall_pre"] = recall_vs_oracle(mesh)
+            for i in range(ramp_steps):
+                mesh.upsert(ramp[i * ramp_rows:(i + 1) * ramp_rows])
+                serve()
+                comp.run_once()   # the advisory rides every poll
+                ctl.step()        # ... and the controller acts on it
+            out["recall_post"] = recall_vs_oracle(mesh)
+            out["shards"] = mesh.n_shards
+        finally:
+            ctl.disarm()
+        out["wall_s"] = time.perf_counter() - t0
+        return mesh, out
+
+    _note("controller ramp: rehearsal")
+    run_window("rehearsal")
+
+    _note("controller ramp: measured window")
+    with obs_compile.attribution() as rec:
+        mesh, out = run_window("measured")
+    assert out["failed"] == 0, (
+        f"{out['failed']} query batches failed across the ramp window")
+    assert out["shards"] == 2 * shards, (
+        f"the controller never resharded: {out['shards']} shards after "
+        f"the ramp (threshold {threshold})")
+    assert rec.compile_s == 0.0, (
+        f"measured window compiled {rec.compile_s}s after rehearsal — the "
+        "controller's flip minted a program the pre-flip warm missed")
+    assert out["recall_post"] >= out["recall_pre"] - 0.02, out
+    # the causal chain, straight off the journal (newest = measured run)
+    sensor = obs_events.query(kind="reshard_advised",
+                              name=mesh.name)[-1]
+    dec = obs_events.query(kind="control/decision", name=mesh.name)[-1]
+    started = obs_events.query(kind="reshard_started",
+                               name=mesh.name)[-1]
+    done = obs_events.query(kind="control/action_completed",
+                            name=mesh.name)[-1]
+    assert sensor["seq"] < dec["seq"] < started["seq"] < done["seq"], (
+        sensor["seq"], dec["seq"], started["seq"], done["seq"])
+    assert dec["evidence"]["trigger_seq"] == sensor["seq"], dec
+    assert started["evidence"]["cause"]["decision_seq"] == dec["seq"], \
+        started
+    assert done["evidence"]["decision_seq"] == dec["seq"], done
+
+    row = {
+        "name": "controller_ramp_100k", "n": n, "d": d,
+        "shards_from": shards, "shards_to": out["shards"],
+        "queries": out["served"] * qbatch,
+        "failed_queries": out["failed"],
+        "recall_pre": round(out["recall_pre"], 4),    # gated by compare.py
+        "recall_post": round(out["recall_post"], 4),  # gated by compare.py
+        "reshard_threshold": threshold,
+        "trigger_seq": sensor["seq"],
+        "decision_seq": dec["seq"],
+        "compile_s_loaded": rec.compile_s,
+        "wall_s": round(out["wall_s"], 1),
+        "controller_note": "compactor watermark -> reshard_advised -> "
+                           "controller admission -> online topology "
+                           "double; zero failed queries, zero cold "
+                           "compiles, recall held; causal seq chain "
+                           "asserted from the journal alone",
+    }
+    events = _events_delta(ev_before)   # gated by compare.py on presence
+    if events is not None:
+        row["events"] = events
+    rows.append(row)
+
+
 def _row_tiered(rows, n=100_000, d=128, n_lists=1024, pq_dim=16, k=10,
                 n_probes=8, ratio=4, m=1024, bucket=256, waves=3, ncl=2000):
     """Beyond-HBM tiered storage A/B (ISSUE 15 acceptance): the SAME
@@ -3090,6 +3372,16 @@ def _run(rows):
         _emit()
 
     if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "controller_drift_100k",
+                   lambda: _row_controller_drift(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "controller_ramp_100k",
+                   lambda: _row_controller_ramp(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "tiered_100k", lambda: _row_tiered(rows))
         _emit()
 
@@ -3212,6 +3504,16 @@ def main(argv=None):
             _setup(rows)
             _row_guard(rows, "reshard_churn_100k",
                        lambda: _row_reshard_churn(rows))
+        elif "--controller" in argv:
+            # closed-loop controller only (ISSUE 18): the iteration path
+            # for ControlPolicy thresholds — the drift→retune recovery
+            # window and the ramp→reshard topology double, each with the
+            # causal seq chain asserted off the journal
+            _setup(rows)
+            _row_guard(rows, "controller_drift_100k",
+                       lambda: _row_controller_drift(rows))
+            _row_guard(rows, "controller_ramp_100k",
+                       lambda: _row_controller_ramp(rows))
         elif "--tiered" in argv:
             # beyond-HBM tiering loop only (ISSUE 15): the iteration path
             # for TierPolicy / refine-hop parameters — the all-HBM vs

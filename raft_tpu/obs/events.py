@@ -114,6 +114,15 @@ KINDS = {
     "serve_retired": "info",
     # SLO verdict transitions (obs/slo.py)
     "slo_verdict": "info",
+    # closed-loop controller decisions (control/controller.py) — every
+    # event embeds the triggering sensor event's seq + evidence inline,
+    # so a decision is replayable from the journal alone
+    "control/decision": "info",
+    "control/skipped": "info",
+    "control/action_completed": "info",
+    "control/action_failed": "error",
+    "control/degraded": "warning",
+    "control/restored": "info",
     # the recorder's own breadcrumb (this module)
     "flight_recorder": "info",
 }

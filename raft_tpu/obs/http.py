@@ -25,6 +25,11 @@ daemon-threaded stdlib ``http.server``:
   ``severity=``, ``component=``, ``name=``, ``since_seq=``, ``limit=``).
   ``since_seq`` is exclusive — poll with the last seen ``seq`` to page
   the tail without gaps or repeats. Always routed (process singleton).
+- ``/debug/control`` — the closed-loop controller
+  (:class:`raft_tpu.control.Controller`) when one is attached via
+  ``controller=``: its :meth:`~raft_tpu.control.Controller.status`
+  (cooldowns, in-flight actuation, last action + outcome) plus the most
+  recent ``control/*`` journal events.
 
 Every other path is a 404 — a scrape-config typo fails loudly at
 deploy time instead of silently scraping metrics from ``/metrcs`` forever
@@ -91,7 +96,8 @@ class MetricsExporter:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: metrics.Registry | None = None,
-                 slo=None, request_log=None, replicas=None):
+                 slo=None, request_log=None, replicas=None,
+                 controller=None):
         reg = registry or metrics.default_registry()
         exporter = self
 
@@ -117,6 +123,20 @@ class MetricsExporter:
                     if exporter.replicas is not None:
                         code, body = _fold_replica_health(
                             code, dict(body), exporter.replicas.health())
+                    if exporter.controller is not None:
+                        # compact controller state rides the health body
+                        # (informational — an automated actuation is not
+                        # degradation; its failures journal as
+                        # control/action_failed)
+                        st = exporter.controller.status()
+                        body = dict(body)
+                        body["control"] = {
+                            "enabled": st["enabled"],
+                            "dry_run": st["dry_run"],
+                            "inflight": st["inflight"],
+                            "last_action": st["last_action"],
+                            "degraded": st["degraded"],
+                        }
                     self._send(code, _JSON_TYPE,
                                json.dumps(body, default=float).encode())
                 elif path == "/debug/mem":
@@ -152,6 +172,20 @@ class MetricsExporter:
                          "last_seq": obs_events.last_seq(),
                          "counts_by_kind": obs_events.counts_by_kind()},
                         default=float).encode())
+                elif path == "/debug/control":
+                    if exporter.controller is None:
+                        self._send(404, _JSON_TYPE, json.dumps(
+                            {"error": "no controller attached — pass "
+                                      "controller= to the exporter"}
+                        ).encode())
+                    else:
+                        from . import events as obs_events
+
+                        self._send(200, _JSON_TYPE, json.dumps(
+                            {"controller": exporter.controller.status(),
+                             "recent": obs_events.query(
+                                 component="control", limit=50)},
+                            default=float).encode())
                 elif path == "/debug/requests":
                     if exporter.request_log is None:
                         self._send(404, _JSON_TYPE, json.dumps(
@@ -168,7 +202,8 @@ class MetricsExporter:
                     self._send(404, "text/plain; charset=utf-8",
                                (f"unknown path {path!r}; endpoints: "
                                 "/metrics, /healthz, /debug/requests, "
-                                "/debug/mem, /debug/events\n").encode())
+                                "/debug/mem, /debug/events, "
+                                "/debug/control\n").encode())
 
             def log_message(self, fmt, *args):
                 # scrapes every few seconds must not spam stderr; the
@@ -178,6 +213,7 @@ class MetricsExporter:
         self.slo = slo
         self.request_log = request_log
         self.replicas = replicas
+        self.controller = controller
         self._server = ThreadingHTTPServer((host, int(port)), Handler)
         self._server.daemon_threads = True
         self.host = host
@@ -206,7 +242,7 @@ class MetricsExporter:
 def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
                         registry: metrics.Registry | None = None,
                         slo=None, request_log=None,
-                        replicas=None) -> MetricsExporter:
+                        replicas=None, controller=None) -> MetricsExporter:
     """Start (or return the already-running) obs HTTP endpoint.
 
     ``port=0`` binds an ephemeral port (read it off the returned
@@ -216,7 +252,10 @@ def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
     :class:`~raft_tpu.stream.ReplicatedShard` or
     :class:`~raft_tpu.stream.ShardedMutableIndex`) folds per-replica
     breaker health into the ``/healthz`` verdict — any group at zero
-    pickable twins is ``failing``/503. One exporter per process
+    pickable twins is ``failing``/503. ``controller=`` (a
+    :class:`raft_tpu.control.Controller`) routes ``/debug/control``
+    (status + recent ``control/*`` journal events) and folds compact
+    controller state into the ``/healthz`` body. One exporter per process
     through this module-level entry (a second call returns the live one —
     attach sources on the first call); construct :class:`MetricsExporter`
     directly for multiples or custom registries.
@@ -227,7 +266,7 @@ def start_http_exporter(port: int = 0, host: str = "127.0.0.1",
             return _active
         _active = MetricsExporter(port=port, host=host, registry=registry,
                                   slo=slo, request_log=request_log,
-                                  replicas=replicas)
+                                  replicas=replicas, controller=controller)
         return _active
 
 

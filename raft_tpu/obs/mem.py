@@ -71,7 +71,7 @@ __all__ = [
     "reaccount", "totals", "reset_peak", "breakdown", "audit", "plan",
     "gate", "unaccounted_index_bytes", "hbm_stats", "note_workspace",
     "debug_payload", "register_pressure_handler",
-    "register_debug_section", "gate_host",
+    "register_debug_section", "gate_host", "headroom",
 ]
 
 
@@ -839,6 +839,45 @@ def gate_host(res, host_bytes, *, site: str, detail: str = "") -> None:
         memory_budget_bytes = None
 
     gate(_HostOnly(), 0, site=site, detail=detail, host_bytes=host_bytes)
+
+
+def headroom(res=None) -> dict | None:
+    """Device-budget headroom snapshot, or ``None`` when no
+    ``memory_budget_bytes`` is armed (an unarmed budget has no headroom
+    to reason about). The control plane's reshard admission reads this —
+    a topology doubling is a double-buffered migration, so it is refused
+    unless enough of the budget is free OR reclaimable by a pressure
+    spill. ``spillable_bytes``/``spillable_frac`` count the tiered
+    stores' device mirrors (caches the gate's pressure handlers drop on
+    demand); both are 0 when no tiered store is live. Fractions are of
+    the budget, so ``headroom_frac + spillable_frac`` is the admission
+    quantity — and the dict inlines as journal evidence verbatim, so a
+    control decision and its admission check can never disagree."""
+    if res is None:
+        from ..core.resources import default_resources
+
+        res = default_resources()
+    budget = getattr(res, "memory_budget_bytes", None)
+    if budget is None:
+        return None
+    budget = int(budget)
+    used = _ledger.totals()["device_bytes"]
+    spillable = 0
+    try:
+        from ..stream.tiered import spillable_bytes
+
+        spillable = int(spillable_bytes())
+    except Exception:  # headroom is a sensor — never the failure itself
+        pass
+    return {
+        "budget_bytes": budget,
+        "device_bytes": int(used),
+        "headroom_bytes": max(0, budget - int(used)),
+        "headroom_frac": (round(max(0.0, 1.0 - used / budget), 4)
+                          if budget else 0.0),
+        "spillable_bytes": spillable,
+        "spillable_frac": (round(spillable / budget, 4) if budget else 0.0),
+    }
 
 
 # -- /debug/mem payload ------------------------------------------------------

@@ -560,8 +560,15 @@ class DriftDetector:
             # labels, move only the measured balance class
             observed = f"{self._n_lab}-{self._d_lab}-{balance}"
         drifted = observed != self.pinned_family
+        # the measured evidence rides the report (and the retune_advised
+        # event) INLINE: the classifier value AND its threshold, plus both
+        # balance classes — a controller (or a postmortem) replays the
+        # decision from the journal alone, re-probing nothing
         report = {"drifted": drifted, "pinned": self.pinned_family,
                   "observed": observed, "scale_cv": round(float(cv), 4),
+                  "scale_cv_threshold": decisions.SCALE_CV_THRESHOLD,
+                  "pinned_balance": self._balance,
+                  "observed_balance": balance,
                   "rows": int(rows.shape[0]), "source": source,
                   "at": self._clock()}
         was = self._drifted.get(source, False)

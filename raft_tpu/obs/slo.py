@@ -223,6 +223,20 @@ class SLOTracker:
                     _g_burn().set(round(burn, 4), objective=o, window=label)
         return out
 
+    def burn_snapshot(self, window_s: float | None = None) -> dict:
+        """Every objective's burn over ONE window (default the shortest
+        configured) plus the window itself — the single-ring-walk
+        snapshot a controller takes at decision time and inlines as
+        evidence (``raft_tpu.control``: reshard admission, the
+        degrade/restore loop, compaction pacing). One dict, one walk:
+        the admission check and its journal evidence can never disagree
+        on a slot boundary."""
+        w = (float(window_s) if window_s is not None
+             else min(self.policy.windows_s))
+        out = {o: round(self.burn_rate(o, w), 4) for o in OBJECTIVES}
+        out["window_s"] = w
+        return out
+
     # -- verdict -------------------------------------------------------------
     def status(self, rates: dict | None = None) -> str:
         """ready / degraded / failing. An objective degrades (fails) the

@@ -134,7 +134,7 @@ class IndexRegistry:
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
                 warm: bool = True, warm_data=None, tuned=None,
-                res=None, warm_hook=None) -> dict:
+                res=None, warm_hook=None, cause: dict | None = None) -> dict:
         """Make ``(index, search_params)`` the active version of ``name``.
 
         Warms the searcher at every registry bucket shape for every ``k``
@@ -178,6 +178,11 @@ class IndexRegistry:
         executables) without a cold window between the flip and its own
         post-publish warm. Its return value lands in
         ``report["warm_hook"]``.
+
+        ``cause`` (a small dict — e.g. the control plane's trigger/decision
+        journal seqs) rides the ``serve_published`` event's evidence
+        verbatim: an automated republish stays causally chained in the
+        journal to the sensor event that advised it.
         """
         from .._warmup import warm_buckets
 
@@ -289,7 +294,8 @@ class IndexRegistry:
                 "serve_published",
                 subject=("serve", name, None, v.version),
                 evidence={"swap": old is not None, "warmed": warm,
-                          "ks": list(v.ks)})
+                          "ks": list(v.ks),
+                          **({"cause": dict(cause)} if cause else {})})
             return report
 
     def publish_lock(self, name: str) -> threading.RLock:

@@ -181,7 +181,7 @@ class SearchService:
     def publish(self, name: str, index, *, search_params=None,
                 k: int | tuple = 10, version: int | None = None,
                 warm: bool = True, warm_data=None, tuned=None,
-                res=None, warm_hook=None) -> dict:
+                res=None, warm_hook=None, cause: dict | None = None) -> dict:
         """Publish/hot-swap through the service's registry, warming against
         the SERVICE's bucket ladder (the shapes its streams actually flush).
         Safe under load: in-flight requests finish on the old version.
@@ -209,7 +209,9 @@ class SearchService:
         change (:meth:`raft_tpu.stream.ShardedMutableIndex.reshard`) uses
         to commit its atomic flip with every new program already warm and
         nothing visible to serving traffic until the registry flips. Its
-        return value lands in ``report["warm_hook"]``."""
+        return value lands in ``report["warm_hook"]``. ``cause`` forwards
+        to the registry and rides the ``serve_published`` event's evidence
+        (the control plane's causal chain — see docs/control.md)."""
         with tracing.range("serve/publish/%s", name):
             # hold the registry's per-name publish lock across flip AND
             # handle bookkeeping: a concurrent publish to the same name
@@ -252,7 +254,7 @@ class SearchService:
                 report = self.registry.publish(
                     name, index, search_params=search_params, k=k,
                     version=version, warm=warm, warm_data=warm_data,
-                    tuned=tuned, res=res, warm_hook=combined)
+                    tuned=tuned, res=res, warm_hook=combined, cause=cause)
                 parts = report.pop("warm_hook", None)
                 if parts:
                     report.update(parts)

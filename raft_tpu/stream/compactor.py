@@ -95,6 +95,15 @@ def _c_reshard_advised():
         "operator or controller calls ShardedMutableIndex.reshard)")
 
 
+@functools.lru_cache(maxsize=None)
+def _c_deferred():
+    return metrics.counter(
+        "raft_tpu_stream_compaction_deferred_total",
+        "due compactions deferred by the external pacing hint (a "
+        "controller's SLO-burn signal — compaction waits out a latency "
+        "burn instead of competing with the serve path)")
+
+
 @dataclass(frozen=True)
 class CompactionPolicy:
     """Watermarks that arm :meth:`Compactor.run_once` (see module doc).
@@ -151,7 +160,7 @@ class Compactor:
     def __init__(self, mutable: MutableIndex, *, publisher=None,
                  name: str | None = None, ks=(10,),
                  policy: CompactionPolicy = CompactionPolicy(),
-                 warm_data=None, drift=None,
+                 warm_data=None, drift=None, pacing=None,
                  clock: Callable[[], float] | None = None,
                  poll_interval_s: float = 0.05):
         expects(publisher is None or hasattr(publisher, "publish"),
@@ -176,6 +185,15 @@ class Compactor:
         expects(drift is None or hasattr(drift, "check"),
                 "drift must be an obs.quality.DriftDetector (check())")
         self._drift = drift
+        # external pacing hint (zero-arg callable -> truthy = defer):
+        # wired by a controller feeding its SLO-burn signal so a due fold
+        # waits out a latency burn (run_once; force= overrides). Default
+        # None = scheduling behavior unchanged.
+        expects(pacing is None or callable(pacing),
+                "pacing must be a zero-arg callable returning truthy to "
+                "defer (e.g. control.Controller wires one)")
+        self._pacing = pacing
+        self.last_deferred: str | None = None
         # default to the MUTABLE's clock: the age watermark subtracts this
         # clock's now from delta_oldest_at stamps taken with the mutable's —
         # two different time bases would silently disable (or constantly
@@ -192,6 +210,23 @@ class Compactor:
         # transition, dedup owned by the journal
         self._advice_tkey = ("compactor/reshard_advice",
                              next(_compactor_ids))
+
+    # -- pacing --------------------------------------------------------------
+    def set_pacing(self, fn) -> None:
+        """(Re)wire the external pacing hint after construction — what
+        :meth:`raft_tpu.control.Controller.attach_compactor` calls.
+        ``None`` unwires it (default scheduling restored)."""
+        expects(fn is None or callable(fn),
+                "pacing must be a zero-arg callable or None")
+        self._pacing = fn
+
+    def _defer(self) -> bool:
+        if self._pacing is None:
+            return False
+        try:
+            return bool(self._pacing())
+        except Exception:  # a broken hint must never stall compaction
+            return False
 
     # -- watermarks ---------------------------------------------------------
     def due(self) -> str | None:
@@ -257,8 +292,13 @@ class Compactor:
                       "threshold": p.reshard_min_rows_per_shard}
         key = ((advice["action"], advice["target"])
                if advice is not None else None)
+        # the payload carries the full measured watermark evidence inline
+        # (live rows, shard count, per-shard mean AND the crossed
+        # threshold): a controller decides — and a postmortem replays —
+        # from the journal alone, re-probing nothing
         payload = None if advice is None else dict(
             advice, name=self._mutable.name, shards=shards,
+            live=int(st["live"]),
             rows_per_shard=round(per, 1), auto_apply=False)
         if not obs_events.transition(self._advice_tkey, key, payload):
             return self.last_advice
@@ -302,6 +342,15 @@ class Compactor:
             if not force:
                 return None
             trigger = "forced"
+        elif not force and self._defer():
+            # a due fold waits out the pacing signal (a controller's SLO
+            # latency burn); the tripped watermark stays tripped and the
+            # next poll retries — reclaim is deferred, never lost
+            self.last_deferred = trigger
+            if metrics._enabled:
+                _c_deferred().inc(1, name=self._mutable.name,
+                                  trigger=trigger)
+            return None
         if mode is None:
             mode = "rebuild" if trigger == "tombstone_ratio" else "auto"
         from ..obs import compile as obs_compile

@@ -923,7 +923,8 @@ class ShardedMutableIndex:
     # -- elastic resharding --------------------------------------------------
     def reshard(self, n_shards: int, *, publisher=None,
                 name: str | None = None, ks=(10,), warm_buckets=None,
-                warm_data=None, res=None) -> dict:
+                warm_data=None, res=None,
+                cause: dict | None = None) -> dict:
         """Online power-of-two split/merge to ``n_shards`` — the topology
         change as a sequence of LOCAL folds, never a stop-the-world.
 
@@ -961,7 +962,13 @@ class ShardedMutableIndex:
         Returns ``{from, to, steps, rows_moved, epoch, wall_s}``. Raises
         (mesh untouched, donors still serving) on a non-power-of-two
         ratio, a successor that would own zero rows, or a shard without
-        its retained row store."""
+        its retained row store.
+
+        ``cause`` (a small dict — e.g. the controller's trigger/decision
+        journal seqs) rides the ``reshard_started`` / ``reshard_committed``
+        / ``reshard_aborted`` evidence verbatim, so an automated topology
+        change stays causally chained to the sensor event that advised
+        it."""
         target = int(n_shards)
         S = len(self._shards)
         expects(target >= 1, "n_shards must be >= 1, got %d", target)
@@ -989,14 +996,15 @@ class ShardedMutableIndex:
                    else len(self._shards) // 2)
             steps.append(self._reshard_step(
                 nxt, publisher=publisher, name=name, ks=kks,
-                warm_buckets=warm_buckets, warm_data=warm_data, res=res))
+                warm_buckets=warm_buckets, warm_data=warm_data, res=res,
+                cause=cause))
         return {"from": S, "to": target, "steps": steps,
                 "rows_moved": sum(st["rows_moved"] for st in steps),
                 "epoch": self._topology_epoch,
                 "wall_s": round(time.perf_counter() - t0, 3)}
 
     def _reshard_step(self, target: int, *, publisher, name, ks,
-                      warm_buckets, warm_data, res) -> dict:
+                      warm_buckets, warm_data, res, cause=None) -> dict:
         """One doubling/halving: fold donors shard-at-a-time, warm, then
         commit (carry-over + flip + manifest). Holds the compaction lock
         for the whole step — a staggered fold and a migration must not
@@ -1012,7 +1020,8 @@ class ShardedMutableIndex:
                 "reshard_started",
                 subject=("reshard", self._name, None,
                          self._topology_epoch),
-                evidence={"action": action, "from": S, "to": target})
+                evidence={"action": action, "from": S, "to": target,
+                          **({"cause": dict(cause)} if cause else {})})
             t0 = time.perf_counter()
             with self._lock:
                 self._migration = {"action": action, "from": S,
@@ -1110,19 +1119,19 @@ class ShardedMutableIndex:
                     # flushes drain on the topology they leased
                     def commit_hook(_searcher, _ks, _step=step):
                         out = self._commit_reshard(succ, snaps, target,
-                                                   action)
+                                                   action, cause=cause)
                         _step.update(out)
                         return out
 
                     step["publish"] = publisher.publish(
                         name, self._searcher_for(succ), k=ks,
                         warm_data=warm_data, res=res,
-                        warm_hook=commit_hook)
+                        warm_hook=commit_hook, cause=cause)
                 else:
                     if warm_buckets:
                         self._rehearse(succ, warm_buckets, ks, warm_data)
                     step.update(self._commit_reshard(succ, snaps, target,
-                                                     action))
+                                                     action, cause=cause))
                 if metrics._enabled:
                     _c_migrations().inc(1, name=self._name, action=action,
                                         phase="completed")
@@ -1134,7 +1143,8 @@ class ShardedMutableIndex:
                     subject=("reshard", self._name, None,
                              step.get("epoch")),
                     evidence={"action": action, "rows_moved": rows_moved,
-                              "carried_over": step.get("carried_over")})
+                              "carried_over": step.get("carried_over"),
+                              **({"cause": dict(cause)} if cause else {})})
                 step["wall_s"] = round(time.perf_counter() - t0, 3)
                 return step
             finally:
@@ -1142,7 +1152,7 @@ class ShardedMutableIndex:
                     self._migration = None
 
     def _commit_reshard(self, successors, snaps, target: int,
-                        action: str) -> dict:
+                        action: str, cause: dict | None = None) -> dict:
         """The atomic flip. Pre-lock: each successor gets its baseline
         atomic snapshot + a fresh WAL (durability armed). Under the mesh
         write lock: carry over every write that landed on a donor after
@@ -1249,7 +1259,8 @@ class ShardedMutableIndex:
                     "reshard_aborted", severity="error",
                     subject=("reshard", self._name, None, new_epoch - 1),
                     evidence={"action": action, "rolled_back_to":
-                              new_epoch - 1})
+                              new_epoch - 1,
+                              **({"cause": dict(cause)} if cause else {})})
                 raise
             obs_events.emit(
                 "reshard_flip",

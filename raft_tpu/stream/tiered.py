@@ -86,7 +86,7 @@ from ..obs import metrics
 from ..testing import faults
 
 __all__ = ["TierPolicy", "TieredStore", "TIERS", "decide_placement",
-           "tier_totals", "debug_tiers"]
+           "tier_totals", "debug_tiers", "spillable_bytes"]
 
 # residency tiers, hottest first — the vocabulary shared by the metrics,
 # /debug/mem, obs.mem.plan(storage="tiered") and the serialized layout
@@ -267,6 +267,16 @@ def _relieve_pressure(need_bytes: int) -> int:
             break
         freed += s.spill(reason="pressure")
     return freed
+
+
+def spillable_bytes() -> int:
+    """HBM bytes a budget-pressure spill could reclaim right now: the sum
+    of every live store's RESIDENT device mirror (exactly what
+    :func:`_relieve_pressure` drops, in the same accounting). The control
+    plane's reshard admission adds this to the budget headroom — a
+    migration's double-buffer may displace caches, never live state. 0
+    when no tiered store is live."""
+    return sum(s.row_bytes for s in list(_stores) if s.mirror_resident)
 
 
 def tier_totals() -> dict:

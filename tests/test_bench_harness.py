@@ -1221,3 +1221,93 @@ def test_compare_gates_lost_capacity_measurement():
     # capacity fields the NEW artifact gained gate nothing
     assert compare.compare(_artifact([{"name": "q", "qps": 1.0}]),
                            old)["regressions"] == []
+
+
+# ---------------------------------------------------------------------------
+# bench.py --controller — the closed-loop controller rows (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def test_controller_drift_row():
+    """The --controller drift row (ISSUE 18 acceptance): a heavytail
+    corpus served at a collapsed operating point recovers through the
+    sensor → sweep → warm-republish loop. Every acceptance bit lives IN
+    the row body (zero failed queries, zero cold compiles after
+    rehearsal, recall recovered, the causal seq chain off the journal) —
+    the small-scale twin must come back clean."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_controller_drift(rows, n=6000, d=32, ncl=64, n_lists=64,
+                                k=5, m=128, n_eval=64, qbatch=32)
+    row = rows[-1]
+    assert row["name"] == "controller_drift_100k" and "error" not in row, \
+        rows
+    assert row["failed_queries"] == 0, row
+    assert row["recall_recovered"] > row["pre_retune_at_k"], row
+    assert row["retuned_version"] == 2, row
+    assert row["compile_s_loaded"] == 0.0, row
+    assert row["trigger_seq"] < row["decision_seq"], row
+    # the event plane saw the whole loop (gated by compare.py on presence)
+    assert row["events"]["retune_advised"] >= 1, row
+    assert row["events"]["control/decision"] >= 1, row
+    assert row["events"]["control/action_completed"] >= 1, row
+
+
+def test_controller_ramp_row():
+    """The --controller ramp row (ISSUE 18 acceptance): an upsert ramp
+    trips the compactor's reshard watermark and the controller doubles
+    the topology online. The row body asserts the acceptance bits itself
+    (zero failed queries, zero cold compiles, recall held, sensor →
+    decision → reshard_started → completed seq chain); the small-scale
+    twin must come back clean."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_controller_ramp(rows, n=4000, d=16, n_lists=32, k=5,
+                               n_probes=8, qbatch=16, n_eval=32,
+                               ramp_steps=4, ramp_rows=64,
+                               delta_capacity=512)
+    row = rows[-1]
+    assert row["name"] == "controller_ramp_100k" and "error" not in row, \
+        rows
+    assert row["failed_queries"] == 0, row
+    assert row["shards_from"] == 2 and row["shards_to"] == 4, row
+    assert row["compile_s_loaded"] == 0.0, row
+    assert row["recall_post"] >= row["recall_pre"] - 0.02, row
+    assert row["trigger_seq"] < row["decision_seq"], row
+    assert row["events"]["reshard_advised"] >= 1, row
+    assert row["events"]["control/decision"] >= 1, row
+    assert row["events"]["reshard_committed"] >= 1, row
+    assert row["events"]["control/action_completed"] >= 1, row
+
+
+def test_controller_flag_runs_only_the_controller_rows(monkeypatch):
+    """`bench.py --controller` is the control-plane iteration loop: setup
+    + the two controller rows, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_controller_drift",
+        lambda rows: rows.append({"name": "controller_drift_100k",
+                                  "failed_queries": 0}))
+    monkeypatch.setattr(
+        bench, "_row_controller_ramp",
+        lambda rows: rows.append({"name": "controller_ramp_100k",
+                                  "failed_queries": 0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--controller"])
+        assert rc == 0 and calls == ["setup"]
+        names = {r.get("name") for r in bench._STATE["rows"]}
+        assert {"controller_drift_100k", "controller_ramp_100k"} <= names
+    finally:
+        bench._STATE["rows"].clear()

@@ -70,10 +70,12 @@ def test_scan_is_not_vacuous():
 # ---------------------------------------------------------------------------
 
 # a documented kind row between the markers: "| `kind` | `severity` | ..."
+# (kind names may be namespaced with "/" — the control plane's
+# ``control/*`` family)
 _KIND_ROW = re.compile(
-    r"^\|\s*`([a-z0-9_]+)`\s*\|\s*`?(info|warning|error)`?", re.M)
+    r"^\|\s*`([a-z0-9_/]+)`\s*\|\s*`?(info|warning|error)`?", re.M)
 # a literal emit call site: emit("kind" / obs_events.emit(\n    "kind"
-_EMIT_SITE = re.compile(r'\bemit\(\s*\n?\s*"([a-z0-9_]+)"')
+_EMIT_SITE = re.compile(r'\bemit\(\s*\n?\s*"([a-z0-9_/]+)"')
 
 
 def _documented_kinds() -> dict:
@@ -126,7 +128,7 @@ def test_kind_scan_is_not_vacuous():
     src, doc = _source_kinds(), _documented_kinds()
     assert len(src) >= 20 and len(doc) >= 20, (len(src), len(doc))
     for kind in ("retune_advised", "reshard_advised", "replica_fenced",
-                 "slo_verdict"):
+                 "slo_verdict", "control/decision"):
         assert kind in src and kind in doc, kind
 
 
