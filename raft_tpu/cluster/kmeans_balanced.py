@@ -233,18 +233,30 @@ def fit(params: KMeansBalancedParams, x, n_clusters: int, res: Resources | None 
 
     Returns (n_clusters, d) float32 centers.
     """
+    from ..core import chunked
+
     res = res or default_resources()
-    x = jnp.asarray(x)
+    # chunked readers (core.chunked — the out-of-core build path) stay
+    # un-materialized until the trainset subsample gather below; the PRNG
+    # key chain is IDENTICAL in both modes, so the streamed build's
+    # centers are bit-equal to the in-core twin's
+    if not chunked.is_reader(x):
+        x = jnp.asarray(x)
     expects(x.ndim == 2, "X must be 2-D")
-    n = x.shape[0]
+    n = int(x.shape[0])
     expects(n_clusters <= n, "n_clusters > n_samples")
     key = as_key(params.seed)
 
     if params.max_train_points is not None and n > params.max_train_points:
         key, ks = jax.random.split(key)
         sub = jax.random.choice(ks, n, (params.max_train_points,), replace=False)
-        x = jnp.take(x, sub, axis=0)
+        # same indices, one gather seam: jnp.take in-core, a host
+        # fancy-gather (+ ingest conversion) on a reader — the ONE host
+        # sync the streamed build pays before its chunk loops
+        x = chunked.take_rows(x, sub)
         n = params.max_train_points
+    elif chunked.is_reader(x):
+        x = chunked.materialize(x)
 
     key, ki, ke = jax.random.split(key, 3)
     init_idx = jax.random.choice(ki, n, (n_clusters,), replace=False)

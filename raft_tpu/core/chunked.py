@@ -1,0 +1,381 @@
+"""Out-of-core corpus access: the chunked reader + chunk staging stage.
+
+ROADMAP item 3's build-side half: every builder used to open with
+``jnp.asarray(dataset)`` — one device array the size of the corpus — so
+BUILD peak, not serve capacity, capped corpus size. This module is the
+seam that removes that ceiling:
+
+- :class:`ChunkedReader` wraps any 2-D row-sliceable source (the
+  canonical case is an ``np.memmap`` over a corpus file; a plain
+  ``np.ndarray`` works too, which is how compaction folds reuse the
+  path) and exposes it as fixed-size row chunks. All four
+  ``neighbors/*`` builds accept it duck-typed (:func:`is_reader`):
+  list fill and PQ residual encoding become per-chunk jitted passes
+  that scatter into the sealed list layout incrementally, so the
+  device never holds more than the index plus two staged chunks.
+- :class:`ChunkStager` is the host→device staging stage between the
+  reader and those passes: each chunk uploads from an immutable staged
+  copy (mutable-buffer rotation is a use-after-rewrite race under
+  async dispatch — see the class docstring) and, when pinned to a
+  device, stages through the same donated identity program as the
+  serve flush path (:func:`stage_fns` — factored out of
+  ``serve/staging.py``), so steady-state staging bytes are CONSTANT
+  (~two chunks per side) and chunk N+1's H2D overlaps chunk N's
+  assign/encode under jax's async dispatch.
+- :func:`take_rows` is the trainset-sampling seam: the coarse trainer
+  (``cluster/kmeans_balanced``) gathers its subsample through it, so
+  the SAME ``jax.random.choice`` indices hit either a device
+  ``jnp.take`` (in-core) or a host fancy-gather on the reader
+  (streamed) — the PRNG key chain is identical in both modes, which is
+  half of the bit-equality contract (the other half: per-row math is
+  chunk-batching-independent; see the streamed extend paths).
+
+Budget pricing lives in ``obs.mem.plan(streamed=True, ...)`` and the
+``site="build_stream"`` admission gate each build runs BEFORE the
+coarse trainer spends anything.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .errors import expects
+
+__all__ = ["DEFAULT_CHUNK_ROWS", "ChunkedReader", "ChunkStager",
+           "is_reader", "take_rows", "materialize", "converted",
+           "device_materialize", "stage_fns"]
+
+# default streaming granule: 64k rows x 128 d x f32 = 32 MiB/chunk —
+# two staged chunks stay well inside the default 2 GiB workspace while
+# amortizing per-chunk dispatch over enough rows to keep the MXU busy.
+# docs/warm_builds.md ("Out-of-core build") carries the sizing rule.
+DEFAULT_CHUNK_ROWS = 65536
+
+
+@functools.cache
+def stage_fns():
+    """The donated staging program (PR 12's discipline, factored out of
+    ``serve/staging.py`` so the build stager and the serve flush path
+    share ONE program): the old device slot is an operand whose buffer
+    XLA may reuse for the new upload — staging bytes never grow with
+    chunk count."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda old, new: jnp.where(True, new, old),
+                   donate_argnums=(0,))
+
+
+def is_reader(x) -> bool:
+    """Duck-typed chunked-reader check used by every build entry point:
+    anything exposing ``chunks()`` / ``take()`` / ``chunk_rows`` streams;
+    arrays (numpy, jax, memmap passed bare) take the in-core path."""
+    return (hasattr(x, "chunks") and hasattr(x, "take")
+            and hasattr(x, "chunk_rows"))
+
+
+class ChunkedReader:
+    """Fixed-size row chunks over a 2-D corpus that need not fit in one
+    array (``np.memmap`` canonical — slices are lazy views whose pages
+    fault in per chunk; see module docstring)."""
+
+    def __init__(self, source, *, chunk_rows: int = DEFAULT_CHUNK_ROWS):
+        expects(hasattr(source, "ndim") and hasattr(source, "shape")
+                and hasattr(source, "dtype"),
+                "ChunkedReader needs an array-like source (np.memmap, "
+                "np.ndarray, ...)")
+        expects(source.ndim == 2, "corpus must be (n, d)")
+        expects(source.shape[0] > 0 and source.shape[1] > 0,
+                "corpus must be non-empty")
+        expects(int(chunk_rows) >= 1, "chunk_rows must be >= 1")
+        self._src = source
+        self.chunk_rows = min(int(chunk_rows), int(source.shape[0]))
+
+    @classmethod
+    def from_file(cls, path, *, dtype=None, shape=None,
+                  chunk_rows: int = DEFAULT_CHUNK_ROWS,
+                  mode: str = "r") -> "ChunkedReader":
+        """Open an on-disk corpus without reading it: ``.npy`` files map
+        through ``np.load(mmap_mode=)``; raw binary needs ``dtype`` +
+        ``shape``."""
+        p = str(path)
+        if p.endswith(".npy"):
+            src = np.load(p, mmap_mode=mode)
+        else:
+            expects(dtype is not None and shape is not None,
+                    "raw corpus files need dtype= and shape=")
+            src = np.memmap(p, dtype=np.dtype(dtype), mode=mode,
+                            shape=tuple(int(s) for s in shape))
+        return cls(src, chunk_rows=chunk_rows)
+
+    # -- array-like surface (what expects()/plan() read) ---------------------
+    @property
+    def shape(self):
+        return tuple(int(s) for s in self._src.shape)
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def dtype(self):
+        return self._src.dtype
+
+    @property
+    def nbytes(self) -> int:
+        n, d = self.shape
+        return n * d * self._src.dtype.itemsize
+
+    def __len__(self) -> int:
+        return self.shape[0]
+
+    # -- streaming surface ----------------------------------------------------
+    @property
+    def n_chunks(self) -> int:
+        return -(-self.shape[0] // self.chunk_rows)
+
+    def chunks(self):
+        """Yield ``(start, block)`` in row order; ``block`` is a lazy
+        host slice of ``chunk_rows`` rows (the last may be short). No
+        device work happens here — the stager owns H2D."""
+        n = self.shape[0]
+        cr = self.chunk_rows
+        for start in range(0, n, cr):
+            yield start, self._src[start:start + cr]
+
+    def take(self, idx):
+        """Host fancy-gather of the given rows (the trainset-sampling
+        seam): touches only the selected pages, returns a fresh host
+        array."""
+        return np.asarray(self._src[np.asarray(idx)])
+
+    def host_view(self):
+        """The raw backing array (memmap or ndarray) — zero-copy; what a
+        ``MutableIndex(dataset=reader)`` keeps as its cold row store."""
+        return self._src
+
+
+class _ConvertedReader:
+    """A reader view whose ``take``/``materialize`` apply a device-side
+    conversion (byte shift, f32 upcast) — how the coarse trainer sees a
+    raw-dtype corpus in the build's exact working domain."""
+
+    def __init__(self, reader, convert):
+        self._reader = reader
+        self._convert = convert
+        self.chunk_rows = reader.chunk_rows
+
+    @property
+    def shape(self):
+        return self._reader.shape
+
+    ndim = 2
+
+    @property
+    def dtype(self):
+        return self._reader.dtype
+
+    def chunks(self):
+        return self._reader.chunks()
+
+    def take(self, idx):
+        import jax.numpy as jnp
+
+        return self._convert(jnp.asarray(self._reader.take(np.asarray(idx))))
+
+    def materialize(self):
+        import jax.numpy as jnp
+
+        return self._convert(jnp.asarray(np.asarray(self._reader.host_view())))
+
+
+def converted(reader, convert) -> _ConvertedReader:
+    """Wrap ``reader`` so gathered rows come back through ``convert``
+    (a device-side fn: raw chunk -> build working domain)."""
+    return _ConvertedReader(reader, convert)
+
+
+def take_rows(x, idx):
+    """Gather rows by index with one semantics across both modes: a
+    device ``jnp.take`` for arrays, a host gather (one sync on ``idx``,
+    then upload) for readers. Per-row values are bit-equal either way —
+    gathering commutes with the elementwise ingest conversions."""
+    import jax.numpy as jnp
+
+    if is_reader(x):
+        return x.take(np.asarray(idx))
+    return jnp.take(jnp.asarray(x), idx, axis=0)
+
+
+def materialize(x):
+    """Whole-corpus view: identity for arrays, the converted device
+    image for reader views (the degenerate trainset == corpus case)."""
+    import jax.numpy as jnp
+
+    if hasattr(x, "materialize"):
+        return x.materialize()
+    if is_reader(x):
+        return jnp.asarray(np.asarray(x.host_view()))
+    return jnp.asarray(x)
+
+
+class ChunkStager:
+    """Double-buffered host→device chunk staging (see module docstring).
+
+    Every upload reads from an IMMUTABLE per-chunk staged copy, never
+    from a reused mutable buffer: under jax's async dispatch a staged
+    array may be read long after ``stage`` returns (CPU zero-copies
+    ``device_put``, so the device array aliases the host memory for its
+    whole lifetime; other backends DMA-read it until the transfer
+    lands), which makes rewriting a rotated buffer a use-after-rewrite
+    race — the serve flush path only gets away with its rotation because
+    flush-completion tracking bounds the in-flight window. Steady-state
+    bytes still sit at ~two chunks per side: jax keeps at most the
+    in-flight copy and its successor alive, so chunk N+1's H2D overlaps
+    chunk N's assign/encode while chunk N-1 frees. ``device=`` pins
+    staging and enables donation through :func:`stage_fns` (constant
+    DEVICE staging bytes by construction); the default unpinned mode is
+    a plain ``device_put`` whose old chunks free by reference drop. The
+    ledger carries both sides under ``build/staging``."""
+
+    def __init__(self, chunk_rows: int, dim: int, dtype, *,
+                 kind: str = "build", device=None):
+        from ..obs import build as build_metrics
+        from ..obs import mem as obs_mem
+        from ..obs import metrics
+
+        expects(int(chunk_rows) >= 1 and int(dim) >= 1,
+                "stager needs chunk_rows >= 1 and dim >= 1")
+        self.chunk_rows = int(chunk_rows)
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.kind = str(kind)
+        self.device = device
+        # assembly buffer for short (tail) chunks only: rows land here so
+        # the pad tail can be zeroed, then the padded block is copied off
+        # like any full chunk
+        self._assembly = np.zeros((self.chunk_rows, self.dim), self.dtype)
+        self._slot = None
+        self._uploads = 0
+        self._donation_frees = 0
+        # device canonicalization caps at 4 B/elt (f64 host rows land f32)
+        self._dev_chunk_bytes = (self.chunk_rows * self.dim
+                                 * min(self.dtype.itemsize, 4))
+        # host side: the assembly buffer + the in-flight staged copy
+        self._mem = obs_mem.account(
+            "build/staging", name=self.kind,
+            host_bytes=2 * self._assembly.nbytes,
+            device_bytes=2 * self._dev_chunk_bytes, owner=self)
+        if metrics.enabled():
+            build_metrics.ooc_chunk_rows().set(self.chunk_rows,
+                                               kind=self.kind)
+
+    @property
+    def host_bytes(self) -> int:
+        return 2 * self._assembly.nbytes
+
+    def stage(self, block):
+        """Copy ``block`` (<= chunk_rows host rows) into a fresh staged
+        array (padding a short tail chunk with zeros), start the upload.
+        Returns the padded ``(chunk_rows, dim)`` device array — pad rows
+        are garbage the per-chunk passes drop (OOB scatter) or slice
+        off. The staged copy is handed to jax and never written again
+        (see class docstring for why that is load-bearing)."""
+        from ..obs import build as build_metrics
+        from ..obs import metrics
+
+        import jax
+
+        n = block.shape[0]
+        if n == self.chunk_rows:
+            # one copy straight off the source pages — memmap slices
+            # materialize here, not earlier
+            staged = np.array(block)
+        else:
+            buf = self._assembly
+            buf[:n] = block
+            buf[n:] = 0
+            staged = np.array(buf)
+        self._uploads += 1
+        if metrics.enabled():
+            build_metrics.ooc_staged_bytes().inc(staged.nbytes,
+                                                 kind=self.kind)
+        if self.device is None:
+            dev = jax.device_put(staged)
+            self._slot = dev  # latest upload; previous frees by ref drop
+            return dev
+        old = self._slot
+        if old is None:
+            dev = jax.device_put(staged, self.device)
+        else:
+            dev = stage_fns()(old, staged)
+            if old.is_deleted():
+                self._donation_frees += 1
+        self._slot = dev
+        return dev
+
+    def stats(self) -> dict:
+        return {"uploads": self._uploads,
+                "donation_frees": self._donation_frees,
+                "host_bytes": self.host_bytes,
+                "device_bytes": 2 * self._dev_chunk_bytes,
+                "pinned": self.device is not None}
+
+    def release(self) -> None:
+        from ..obs import mem as obs_mem
+
+        if self._mem is not None:
+            obs_mem.release(self._mem)
+            self._mem = None
+        self._slot = None
+
+
+def device_materialize(reader, *, stager: ChunkStager | None = None,
+                       kind: str = "build"):
+    """Stream a reader into ONE device array of its (canonicalized)
+    dtype — for the builds whose index stores the dataset itself
+    (brute_force, cagra): the corpus still ends up device-resident, but
+    arrives through the staged chunk pipeline instead of one host-side
+    ``jnp.asarray`` of the whole corpus (no second full-size host copy,
+    and H2D overlaps the concatenation scatters)."""
+    from ..obs import build as build_metrics
+    from ..obs import metrics
+
+    import jax.numpy as jnp
+
+    n, d = reader.shape
+    cr = reader.chunk_rows
+    own = stager is None
+    if own:
+        stager = ChunkStager(cr, d, reader.dtype, kind=kind)
+    place = _place_fns()
+    dst = jnp.zeros((n, d), jnp.asarray(np.zeros((), reader.dtype)).dtype)
+    try:
+        for start, block in reader.chunks():
+            dev = stager.stage(block)
+            n_valid = block.shape[0]
+            if n_valid < cr:
+                dev = dev[:n_valid]
+            dst = place(dst, dev, jnp.int32(start))
+            if metrics.enabled():
+                build_metrics.ooc_chunks().inc(1, kind=kind,
+                                               stage="materialize")
+    finally:
+        if own:
+            stager.release()
+    return dst
+
+
+@functools.cache
+def _place_fns():
+    import jax
+    from jax import lax
+
+    # start rides as a DEVICE scalar so chunk index never enters the
+    # executable key — one program per (dst, chunk) shape pair
+    return jax.jit(
+        lambda dst, chunk, start: lax.dynamic_update_slice(
+            dst, chunk, (start, 0)),
+        donate_argnums=(0,))

@@ -62,13 +62,18 @@ class Resources:
         full-precision refine rows in host RAM, and this is the budget
         those rows admit against at store construction, through the same
         :func:`raft_tpu.obs.mem.gate` and with the same whole-or-nothing
-        ``MemoryBudgetError`` taxonomy as the device budget. Scope is the
-        tiered stores ONLY (they dominate host bytes at beyond-HBM
-        scale); the stream layer's smaller host arrays — delta
+        ``MemoryBudgetError`` taxonomy as the device budget. Scope:
+        tiered raw-row stores (they dominate host bytes at beyond-HBM
+        scale) and the out-of-core streamed build's host peak — staging
+        buffers plus the trainset gather off the corpus reader, priced
+        by ``obs.mem.plan(streamed=True)`` and refused at
+        ``site="build_stream/host"`` before the coarse trainer spends
+        anything. The stream layer's smaller host arrays — delta
         memtables, bitsets, id maps — are ledger-visible
         (``raft_tpu_mem_host_bytes``) but not yet gated. Stores placed
         on disk (``TierPolicy.disk_path``) price nothing here — mmap
-        pages are disk-backed.
+        pages are disk-backed; so does an ``np.memmap`` corpus a
+        ``core.chunked.ChunkedReader`` streams from.
     """
 
     device: Optional[Any] = None

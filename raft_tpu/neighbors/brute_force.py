@@ -326,12 +326,31 @@ class BruteForce:
         # downcasts f64 to f32 at asarray; byte dtypes store natively).
         import numpy as np
 
+        from ..core import chunked
+
+        res = res or default_resources()
         arr = (dataset if hasattr(dataset, "shape")
                and hasattr(dataset, "dtype") else np.asarray(dataset))
-        need = arr.shape[0] * arr.shape[1] * min(arr.dtype.itemsize, 4)
-        obs_mem.gate(res or default_resources(), need, site="build",
-                     detail=f"brute_force {arr.shape[0]}x{arr.shape[1]}")
-        self.dataset = jnp.asarray(dataset)
+        if chunked.is_reader(dataset):
+            # out-of-core ingest: the dataset still lands device-whole
+            # (it IS the scan operand) but arrives through the staged
+            # chunk pipeline — no second full-size host copy. Priced
+            # against BOTH budgets before any chunk stages.
+            n, d = (int(s) for s in arr.shape)
+            pl = obs_mem.plan("brute_force", None, n, d,
+                              dtype=str(arr.dtype), streamed=True,
+                              chunk_rows=dataset.chunk_rows)
+            obs_mem.gate(res, pl["build_peak_bytes"],
+                         site="build_stream",
+                         host_bytes=pl["host_peak_bytes"],
+                         detail=f"brute_force {n}x{d} streamed")
+            self.dataset = chunked.device_materialize(dataset,
+                                                      kind="brute_force")
+        else:
+            need = arr.shape[0] * arr.shape[1] * min(arr.dtype.itemsize, 4)
+            obs_mem.gate(res, need, site="build",
+                         detail=f"brute_force {arr.shape[0]}x{arr.shape[1]}")
+            self.dataset = jnp.asarray(dataset)
         obs_mem.account_index(self)  # ledger hook (docs/observability.md)
         return self
 

@@ -33,6 +33,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core import tracing
@@ -586,8 +587,28 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
     dataset in its native 8-bit dtype — uint8 shifted by -128 into the s8
     domain, L2-invariant — and the whole build pipeline (IVF-PQ self-search,
     exact refine, pruning) runs on the exact f32 image of those bytes)."""
+    from ..core import chunked
+
     res = res or default_resources()
-    x = jnp.asarray(dataset)
+    stream = chunked.is_reader(dataset)
+    if stream:
+        # out-of-core ingest: price the streamed upload against BOTH
+        # budgets, then land the corpus device-whole through the staged
+        # chunk pipeline (the graph build itself runs in-core — CAGRA's
+        # scan operand is the dataset)
+        n, d = (int(s) for s in dataset.shape)
+        kind = (str(dataset.dtype)
+                if np.dtype(dataset.dtype) in (np.dtype(np.int8),
+                                               np.dtype(np.uint8))
+                else "float32")
+        pl = obs_mem.plan("cagra", params, n, d, dtype=kind,
+                          streamed=True, chunk_rows=dataset.chunk_rows)
+        obs_mem.gate(res, pl["build_peak_bytes"], site="build_stream",
+                     host_bytes=pl["host_peak_bytes"],
+                     detail=f"cagra {n}x{d} streamed")
+        x = chunked.device_materialize(dataset, kind="cagra")
+    else:
+        x = jnp.asarray(dataset)
     expects(x.ndim == 2, "dataset must be (n, d)")
     expects(params.graph_degree <= params.intermediate_graph_degree,
             "graph_degree must be <= intermediate_graph_degree")
@@ -604,11 +625,13 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> CagraIn
         kind = str(x.dtype)
         x = _as_signed(x)  # stored (and scored) in the shifted s8 domain
     # memory-budget admission (no-op unless res.memory_budget_bytes is
-    # set): refuse BEFORE the knn-graph self-search spends anything
-    obs_mem.gate(res, lambda: obs_mem.plan(
-        "cagra", params, x.shape[0], x.shape[1],
-        dtype=kind)["index_bytes"],
-        site="build", detail=f"cagra {x.shape[0]}x{x.shape[1]}")
+    # set): refuse BEFORE the knn-graph self-search spends anything; the
+    # streamed gate above already priced the chunked upload
+    if not stream:
+        obs_mem.gate(res, lambda: obs_mem.plan(
+            "cagra", params, x.shape[0], x.shape[1],
+            dtype=kind)["index_bytes"],
+            site="build", detail=f"cagra {x.shape[0]}x{x.shape[1]}")
     t0 = time.perf_counter()
     with tracing.range("cagra.build.knn_graph"):
         knn_graph = build_knn_graph(params, x, res=res)

@@ -29,11 +29,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
-from ..core import tracing
+from ..core import chunked, tracing
 from ..core.errors import expects
 from ..core.resources import Resources, default_resources
 from ..core.serialize import (check_header, deserialize_mdspan, deserialize_scalar,
@@ -222,6 +223,29 @@ def _resolve_storage(list_dtype: str, x, mt: DistanceType):
     return ld, x, x.astype(jnp.float32)
 
 
+def _stream_probe(dtype, d: int):
+    """A zero-row device array in the reader's CANONICALIZED dtype: lets
+    :func:`_resolve_storage` run its full validation/resolution without the
+    corpus ever materializing. Canonicalization through the device matches
+    what ``jnp.asarray`` does to the in-core twin (f64 host rows land f32),
+    so the resolved storage dtype is identical in both modes."""
+    return jnp.asarray(np.zeros((0, d), dtype))
+
+
+def _stream_f32_view(kind: str):
+    """Device-side conversion raw chunk -> the f32 working view the coarse
+    trainer sees — the streamed twin of :func:`_resolve_storage`'s third
+    return. Elementwise (byte shift, upcast), so it COMMUTES with the
+    trainset row gather: ``convert(take(corpus, idx)) ==
+    take(convert(corpus), idx)`` bitwise — half the bit-equality
+    contract (core/chunked module docstring)."""
+    if kind in ("int8", "uint8"):
+        from .brute_force import _as_signed
+
+        return lambda v: _as_signed(v).astype(jnp.float32)
+    return lambda v: v.astype(jnp.float32)
+
+
 @instrument("ivf_flat.build",
             items=lambda a, kw: nrows(a[1] if len(a) > 1 else kw["dataset"]),
             labels=lambda a, kw: {
@@ -232,9 +256,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
     """Build the index (reference: ivf_flat::build, ivf_flat-inl.cuh;
     coarse centers via balanced k-means on a training subsample, then fill)."""
     res = res or default_resources()
-    x = jnp.asarray(dataset)
-    expects(x.ndim == 2, "dataset must be (n, d)")
-    n, d = x.shape
+    stream = chunked.is_reader(dataset)
+    x = None if stream else jnp.asarray(dataset)
+    src = dataset if stream else x
+    expects(src.ndim == 2, "dataset must be (n, d)")
+    n, d = (int(s) for s in src.shape)
     expects(params.n_lists <= n, "n_lists > n_samples")
     mt = resolve_metric(params.metric)
     expects(
@@ -250,13 +276,32 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
         mt.name,
     )
 
-    kind, x, xf = _resolve_storage(params.list_dtype, x, mt)
-    # memory-budget admission (no-op unless res.memory_budget_bytes is set):
-    # refuse BEFORE the coarse trainer spends anything
-    obs_mem.gate(res, lambda: obs_mem.plan(
-        "ivf_flat", params, n, d,
-        dtype=kind if kind in ("int8", "uint8", "bfloat16") else "float32"
-    )["index_bytes"], site="build", detail=f"ivf_flat {n}x{d}")
+    if stream:
+        # dtype-only storage resolution (same validation, on an empty
+        # probe — the corpus never materializes here), then the STREAMED
+        # admission: price the chunked build peak against BOTH budgets
+        # before the coarse trainer spends anything
+        kind, probe_x, _ = _resolve_storage(
+            params.list_dtype, _stream_probe(dataset.dtype, d), mt)
+        plan_kw = dict(
+            dtype=kind if kind in ("int8", "uint8", "bfloat16") else "float32",
+            streamed=True, chunk_rows=dataset.chunk_rows)
+        obs_mem.gate(
+            res,
+            lambda: obs_mem.plan("ivf_flat", params, n, d,
+                                 **plan_kw)["build_peak_bytes"],
+            site="build_stream", detail=f"ivf_flat {n}x{d} ooc",
+            host_bytes=lambda: obs_mem.plan("ivf_flat", params, n, d,
+                                            **plan_kw)["host_peak_bytes"])
+        xf = chunked.converted(dataset, _stream_f32_view(kind))
+    else:
+        kind, x, xf = _resolve_storage(params.list_dtype, x, mt)
+        # memory-budget admission (no-op unless res.memory_budget_bytes is
+        # set): refuse BEFORE the coarse trainer spends anything
+        obs_mem.gate(res, lambda: obs_mem.plan(
+            "ivf_flat", params, n, d,
+            dtype=kind if kind in ("int8", "uint8", "bfloat16") else "float32"
+        )["index_bytes"], site="build", detail=f"ivf_flat {n}x{d}")
     max_train = max(int(n * params.kmeans_trainset_fraction), params.n_lists)
     train_metric = "inner_product" if mt == DistanceType.InnerProduct else "sqeuclidean"
     kb = KMeansBalancedParams(
@@ -271,7 +316,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
         _count_fill_pass(kb, n)
 
     storage = {"bfloat16": jnp.bfloat16, "int8": jnp.int8,
-               "uint8": jnp.int8}.get(kind, x.dtype)
+               "uint8": jnp.int8}.get(kind, probe_x.dtype if stream
+                                      else x.dtype)
 
     if not params.add_data_on_build:
         cap = 8
@@ -288,21 +334,26 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfFlat
         obs_mem.account_index(empty)
         return empty
 
-    return _extend_signed(
-        IvfFlatIndex(
-            centers=centers,
-            list_data=jnp.zeros((params.n_lists, 0, d), storage),
-            list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
-            list_norms=jnp.zeros((params.n_lists, 0), jnp.float32),
-            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
-            metric=mt,
-            split_factor=params.split_factor,
-            data_kind=kind,
-        ),
-        x,
-        jnp.arange(n, dtype=jnp.int32),
-        res=res,
+    seed = IvfFlatIndex(
+        centers=centers,
+        list_data=jnp.zeros((params.n_lists, 0, d), storage),
+        list_ids=jnp.zeros((params.n_lists, 0), jnp.int32),
+        list_norms=jnp.zeros((params.n_lists, 0), jnp.float32),
+        list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+        metric=mt,
+        split_factor=params.split_factor,
+        data_kind=kind,
     )
+    if stream:
+        return _extend_stream_signed(seed, dataset, None, res=res)
+    return _extend_signed(seed, x, jnp.arange(n, dtype=jnp.int32), res=res)
+
+
+# host batches past this size stream through the chunked path instead of
+# one whole-batch ``jnp.asarray`` — the extend() full-materialization fix
+# (a 1M x 128 f32 batch is 512 MiB of device scratch the chunked path
+# replaces with two 32 MiB staged chunks)
+_STREAM_EXTEND_BYTES = 256 << 20
 
 
 @instrument("ivf_flat.extend",
@@ -313,7 +364,19 @@ def extend(index: IvfFlatIndex, new_vectors, new_ids=None, res: Resources | None
 
     Capacity is data-dependent, so extend re-packs lists host-orchestrated:
     existing + new vectors are re-scattered into a freshly sized padded array
-    (the reference reallocates lists too — ivf_list.hpp resize)."""
+    (the reference reallocates lists too — ivf_list.hpp resize).
+
+    A :class:`~raft_tpu.core.chunked.ChunkedReader` batch (or any host
+    ndarray past ``_STREAM_EXTEND_BYTES``) takes the out-of-core path:
+    per-chunk assign + scatter, never materializing the batch on device."""
+    if (not chunked.is_reader(new_vectors)
+            and isinstance(new_vectors, np.ndarray)
+            and new_vectors.ndim == 2
+            and new_vectors.nbytes > _STREAM_EXTEND_BYTES):
+        new_vectors = chunked.ChunkedReader(new_vectors)
+    if chunked.is_reader(new_vectors):
+        return _extend_stream_signed(index, new_vectors, new_ids, res=res,
+                                     split_factor=split_factor)
     x = jnp.asarray(new_vectors)
     if index.data_kind in ("int8", "uint8"):
         # 8-bit indexes take vectors in the index's ORIGINAL dtype; a plain
@@ -393,6 +456,168 @@ def _extend_signed(index: IvfFlatIndex, new_vectors, new_ids=None,
     # ledger hook (docs/observability.md): the new padded lists are the
     # long-lived allocation; the superseded index's entry auto-releases
     # when the caller drops it
+    obs_mem.account_index(out)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists",),
+                   donate_argnums=(0, 1, 2, 3))
+def _fill_chunk(data, idbuf, norms, offsets, x, ids, labels, n_lists: int):
+    """One streamed scatter pass: place a chunk's rows at their running
+    within-list offsets (``offsets`` carries each list's fill level across
+    chunks — chunk-local rank + prior count equals the full-array rank
+    ``list_positions`` would assign, since both orderings are stable by
+    input position). Pad rows arrive labelled ``n_lists`` (one past the
+    last list): their position math lands in the sentinel slot of the
+    extended count/offset vectors and the scatter drops them out of
+    bounds — no host-side filtering, so the chunk loop never syncs.
+    Donation reuses the accumulator buffers in place, which is what keeps
+    the build's device peak FLAT in chunk count."""
+    pos_local, counts = list_positions(labels, n_lists + 1)
+    offs = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
+    pos = pos_local + jnp.take(offs, labels)
+    data = data.at[labels, pos].set(x, mode="drop")
+    idbuf = idbuf.at[labels, pos].set(ids.astype(jnp.int32), mode="drop")
+    xf = x.astype(jnp.float32)
+    norms = norms.at[labels, pos].set(jnp.sum(xf * xf, axis=1), mode="drop")
+    return data, idbuf, norms, offsets + counts[:n_lists]
+
+
+def _extend_stream_signed(index: IvfFlatIndex, reader, new_ids=None,
+                          res: Resources | None = None,
+                          split_factor: float | None = None) -> IvfFlatIndex:
+    """The streamed twin of :func:`_extend_signed`: two passes over the
+    reader's chunks (assign, then scatter) instead of one whole-corpus
+    device array. Bit-equal to the in-core path because every per-row
+    quantity — ingest conversion, nearest-center label, within-list rank,
+    norm — comes from the SAME helpers and none couples rows across a
+    batch (tests/test_ooc_build.py asserts the full-index equality). The
+    one intentional divergence: ``bound_capacity``'s spatial mega-cluster
+    split needs the whole corpus on device, so severely oversized lists
+    fall back to the order split here. Device peak is index accumulators
+    + two staged chunks + the label/id vectors — CONSTANT in corpus rows
+    beyond the index itself (the ``ooc_build`` bench row's claim)."""
+    from ..obs import build as build_metrics
+    from ..obs import metrics as _metrics
+
+    res = res or default_resources()
+    n_new, d = (int(s) for s in reader.shape)
+    expects(d == index.dim, "vector dim mismatch")
+    storage_dt = index.list_data.dtype
+    if index.data_kind in ("int8", "uint8"):
+        expects(str(reader.dtype) == index.data_kind,
+                "this index stores %s vectors; got %s", index.data_kind,
+                reader.dtype)
+        from .brute_force import _as_signed
+
+        def ingest(v):
+            return _as_signed(v).astype(storage_dt)
+    else:
+        def ingest(v):
+            return v.astype(storage_dt)
+
+    if new_ids is None:
+        new_ids = index.size + jnp.arange(n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+        expects(int(new_ids.shape[0]) == n_new, "ids/vectors length mismatch")
+
+    cr = int(reader.chunk_rows)
+    emit = _metrics.enabled()
+    stager = chunked.ChunkStager(cr, d, reader.dtype, kind="ivf_flat")
+    try:
+        # ---- pass A: per-chunk nearest-center assignment. Labels stay
+        # DEVICE-resident parts until one concatenate at the end — the
+        # loop itself never syncs the host (satellite guard:
+        # test_ooc_build asserts a repeat build compiles nothing).
+        tile = _choose_tile(cr, index.n_lists, 1, res.workspace_bytes)
+        parts = []
+        with tracing.range("ivf_flat.extend.assign_stream"):
+            for start, block in reader.chunks():
+                xs = ingest(stager.stage(block))
+                xa = xs.astype(jnp.float32) if xs.dtype == jnp.int8 else xs
+                parts.append(assign_to_lists(xa, index.centers,
+                                             index.metric, tile))
+                if emit:
+                    build_metrics.ooc_chunks().inc(1, kind="ivf_flat",
+                                                   stage="assign")
+        labels = jnp.concatenate(parts)[:n_new]  # drop pad-row garbage
+        del parts
+
+        # merge with existing list contents (flatten old lists back to
+        # rows — same ordering as _extend_signed: OLD FIRST, so stable
+        # ranks, and therefore the final layout, agree with the in-core
+        # twin)
+        n_old = 0
+        old_x = old_ids = None
+        if index.capacity > 0 and index.size > 0:
+            old_mask = index.list_ids.reshape(-1) >= 0
+            old_x = index.list_data.reshape(-1, d)[old_mask]
+            old_ids = index.list_ids.reshape(-1)[old_mask]
+            old_labels = jnp.repeat(jnp.arange(index.n_lists),
+                                    index.capacity)[old_mask]
+            n_old = int(old_x.shape[0])
+            labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
+
+        # capacity policy over the FULL label vector (one host sync for
+        # the max size — per build, not per chunk). x=None: the spatial
+        # split would need the whole corpus device-resident, so severe
+        # lists order-split instead (see docstring).
+        sf = index.split_factor if split_factor is None else split_factor
+        labels, rep, n_lists2, capacity, _ = bound_capacity(
+            labels, index.n_lists, sf, x=None)
+        centers = index.centers
+        if rep is not None:
+            centers = jnp.asarray(np.repeat(np.asarray(centers), rep,
+                                            axis=0))
+
+        # ---- pass B: chunked scatter into the sealed layout -----------
+        data = jnp.zeros((n_lists2, capacity, d), storage_dt)
+        idbuf = jnp.full((n_lists2, capacity), -1, jnp.int32)
+        norms = jnp.full((n_lists2, capacity), jnp.inf, jnp.float32)
+        offsets = jnp.zeros((n_lists2,), jnp.int32)
+        # transient ledger entry: the accumulators + label/id vectors ARE
+        # the streamed build's device working set (plan()'s streamed-mode
+        # estimate prices exactly this); released before the sealed index
+        # is accounted so /debug/mem never double-counts the layout
+        ooc_tok = obs_mem.account(
+            "build/ooc", name="ivf_flat",
+            device_bytes=int(data.nbytes + idbuf.nbytes + norms.nbytes
+                             + offsets.nbytes + labels.nbytes
+                             + new_ids.nbytes),
+            owner=stager)
+        with tracing.range("ivf_flat.extend.fill_stream"):
+            if n_old > 0:
+                data, idbuf, norms, offsets = _fill_chunk(
+                    data, idbuf, norms, offsets, old_x, old_ids,
+                    labels[:n_old], n_lists=n_lists2)
+                labels = labels[n_old:]
+            # pad the tail so every chunk's slice is full-size (ONE
+            # executable): sentinel label n_lists2 -> scatter dropped
+            pad = -(-n_new // cr) * cr - n_new
+            lab_p = (jnp.concatenate(
+                [labels, jnp.full((pad,), n_lists2, jnp.int32)])
+                if pad else labels)
+            ids_p = (jnp.concatenate(
+                [new_ids, jnp.full((pad,), -1, jnp.int32)])
+                if pad else new_ids)
+            for start, block in reader.chunks():
+                xs = ingest(stager.stage(block))
+                st = jnp.int32(start)  # operand, not executable key
+                lab_c = lax.dynamic_slice_in_dim(lab_p, st, cr)
+                ids_c = lax.dynamic_slice_in_dim(ids_p, st, cr)
+                data, idbuf, norms, offsets = _fill_chunk(
+                    data, idbuf, norms, offsets, xs, ids_c, lab_c,
+                    n_lists=n_lists2)
+                if emit:
+                    build_metrics.ooc_chunks().inc(1, kind="ivf_flat",
+                                                   stage="fill")
+        sizes = offsets
+        obs_mem.release(ooc_tok)
+    finally:
+        stager.release()
+    out = IvfFlatIndex(centers, data, idbuf, norms, sizes, index.metric, sf,
+                       index.data_kind)
     obs_mem.account_index(out)
     return out
 

@@ -32,11 +32,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..cluster import kmeans_balanced
 from ..cluster.kmeans_balanced import KMeansBalancedParams
-from ..core import tracing
+from ..core import chunked, tracing
 from ..core.errors import expects
 from ..core.logger import logger
 from ..obs import mem as obs_mem
@@ -414,6 +415,19 @@ def _resolve_pq_ingest(x, mt: DistanceType):
     return str(x.dtype), _as_signed(x).astype(jnp.float32)
 
 
+def _stream_ingest(data_kind: str):
+    """Device-side conversion raw chunk -> the build's f32 working domain —
+    the streamed twin of :func:`_resolve_pq_ingest`'s second return. Float
+    data passes through untouched (exactly as in-core, where the working
+    view IS the ingested array); bytes shift + upcast. Elementwise, so it
+    commutes with the trainset row gather (bit-equality contract)."""
+    if data_kind in ("int8", "uint8"):
+        from .brute_force import _as_signed
+
+        return lambda v: _as_signed(v).astype(jnp.float32)
+    return lambda v: v
+
+
 def _default_pq_dim(d: int, pq_bits: int = 4) -> int:
     """Bits-aware variant of the reference heuristic (ivf_pq_types.hpp:81,
     ~d/2 at its default 8 bits): the auto pq_dim keeps CODE BYTES equal to
@@ -542,10 +556,14 @@ def _per_cluster_gain(resid, labels, codebooks, split: bool, key, n_iters: int,
     # what search actually scores against (ADVICE r3)
     cb_ps = _composed_codebooks(codebooks) if split else codebooks  # (pq_dim, K, L)
     k_codes = cb_ps.shape[1]
-    counts = np.bincount(np.asarray(labels), minlength=1)
+    # ONE host round-trip for the labels: counts and the per-cluster row
+    # pools both derive from the same materialized array (two separate
+    # np.asarray(labels) syncs here used to stall the dispatch queue twice
+    # per auto build)
+    lab_h = np.asarray(labels)
+    counts = np.bincount(lab_h, minlength=1)
     trial = np.argsort(counts)[::-1][:n_trial]
     trial = trial[counts[trial] > 0]
-    lab_h = np.asarray(labels)
     pools = []
     cap = min(member_cap, int(counts[trial].max()))
     for c in trial:
@@ -941,9 +959,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     """Build the index (reference: ivf_pq::build, ivf_pq-inl.cuh:270; call
     stack SURVEY.md §3.B)."""
     res = res or default_resources()
-    x = jnp.asarray(dataset)
-    expects(x.ndim == 2, "dataset must be (n, d)")
-    n, d = x.shape
+    stream = chunked.is_reader(dataset)
+    x = None if stream else jnp.asarray(dataset)
+    src = dataset if stream else x
+    expects(src.ndim == 2, "dataset must be (n, d)")
+    n, d = (int(s) for s in src.shape)
     expects(params.n_lists <= n, "n_lists > n_samples")
     expects(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8] (ref ivf_pq_types.hpp:68)")
     mt = resolve_metric(params.metric)
@@ -964,12 +984,35 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
             "fast_scan must be 'none', '1bit' or '4bit', got %r",
             params.fast_scan)
 
-    data_kind, x = _resolve_pq_ingest(x, mt)
-    # memory-budget admission (no-op unless res.memory_budget_bytes is
-    # set): refuse BEFORE the coarse trainer spends anything
-    obs_mem.gate(res, lambda: obs_mem.plan(
-        "ivf_pq", params, n, d)["index_bytes"],
-        site="build", detail=f"ivf_pq {n}x{d}")
+    if stream:
+        # dtype-only ingest resolution (same validation, on an empty
+        # probe — the corpus never materializes here), then the STREAMED
+        # admission: price the chunked build peak against BOTH budgets
+        # before the coarse trainer spends anything
+        from .ivf_flat import _stream_probe
+
+        data_kind, _ = _resolve_pq_ingest(_stream_probe(dataset.dtype, d),
+                                          mt)
+        plan_kw = dict(
+            dtype=data_kind if data_kind in ("int8", "uint8") else "float32",
+            streamed=True, chunk_rows=dataset.chunk_rows)
+        obs_mem.gate(
+            res,
+            lambda: obs_mem.plan("ivf_pq", params, n, d,
+                                 **plan_kw)["build_peak_bytes"],
+            site="build_stream", detail=f"ivf_pq {n}x{d} ooc",
+            host_bytes=lambda: obs_mem.plan("ivf_pq", params, n, d,
+                                            **plan_kw)["host_peak_bytes"])
+        # the coarse trainer and the trainset gather below see the reader
+        # through the build's exact working-domain conversion
+        x = chunked.converted(dataset, _stream_ingest(data_kind))
+    else:
+        data_kind, x = _resolve_pq_ingest(x, mt)
+        # memory-budget admission (no-op unless res.memory_budget_bytes is
+        # set): refuse BEFORE the coarse trainer spends anything
+        obs_mem.gate(res, lambda: obs_mem.plan(
+            "ivf_pq", params, n, d)["index_bytes"],
+            site="build", detail=f"ivf_pq {n}x{d}")
     pq_dim = params.pq_dim or _default_pq_dim(d, params.pq_bits)
     pq_len = -(-d // pq_dim)
     d_rot = pq_dim * pq_len
@@ -1004,9 +1047,11 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     key, ks = jax.random.split(key)
     if n_train < n:
         train_idx = jax.random.choice(ks, n, (n_train,), replace=False)
-        xt = jnp.take(x, train_idx, axis=0)
+        # take_rows: jnp.take in-core, a host page-gather off the reader
+        # streamed — SAME indices, bit-equal rows (core/chunked docstring)
+        xt = chunked.take_rows(x, train_idx)
     else:
-        xt = x
+        xt = chunked.materialize(x) if stream else x
     tile = _choose_tile(n_train, params.n_lists, 1, res.workspace_bytes)
     with tracing.range("ivf_pq.build.residuals"):
         labels = assign_to_lists(xt, centers, mt, tile)
@@ -1153,6 +1198,8 @@ def build(params: IndexParams, dataset, res: Resources | None = None) -> IvfPqIn
     if not params.add_data_on_build:
         obs_mem.account_index(index)
         return index
+    if stream:
+        return _extend_stream_f32(index, dataset, None, res=res)
     # x is already the f32 working view (byte data was shifted+upcast above)
     return _extend_f32(index, x, jnp.arange(n, dtype=jnp.int32), res=res)
 
@@ -1210,7 +1257,22 @@ def extend(index: IvfPqIndex, new_vectors, new_ids=None, res: Resources | None =
     """Encode + append vectors (reference: ivf_pq::extend; encode path
     process_and_fill_codes, detail/ivf_pq_build.cuh). Byte indexes
     (data_kind int8/uint8) take vectors in the index's ORIGINAL dtype —
-    a plain astype would wrap uint8 values mod 256 instead of shifting."""
+    a plain astype would wrap uint8 values mod 256 instead of shifting.
+
+    A :class:`~raft_tpu.core.chunked.ChunkedReader` batch (or any host
+    ndarray past the streaming threshold) takes the out-of-core path:
+    per-chunk assign + encode + scatter, never materializing the batch on
+    device."""
+    from .ivf_flat import _STREAM_EXTEND_BYTES
+
+    if (not chunked.is_reader(new_vectors)
+            and isinstance(new_vectors, np.ndarray)
+            and new_vectors.ndim == 2
+            and new_vectors.nbytes > _STREAM_EXTEND_BYTES):
+        new_vectors = chunked.ChunkedReader(new_vectors)
+    if chunked.is_reader(new_vectors):
+        return _extend_stream_f32(index, new_vectors, new_ids, res=res,
+                                  split_factor=split_factor)
     x = jnp.asarray(new_vectors)
     if index.data_kind in ("int8", "uint8"):
         expects(str(x.dtype) == index.data_kind,
@@ -1333,6 +1395,220 @@ def _extend_f32(index: IvfPqIndex, new_vectors, new_ids=None,
     if metrics.enabled():
         g = _quant_bytes_per_row()
         g.set(index.pq_dim + 4, tier="pq")
+        if index.has_fast_scan:
+            g.set(index.list_sig.shape[2] + 4, tier="sig")
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_lists",),
+                   donate_argnums=(0, 1, 2, 3, 4))
+def _fill_pq_chunk(buf, idbuf, cbuf, sbuf, offsets, codes, ids, labels,
+                   consts, sig, n_lists: int):
+    """One streamed scatter pass over the PQ list layout — the ivf_pq twin
+    of ``ivf_flat._fill_chunk`` (same running-offset position math, same
+    sentinel-label OOB drop for pad rows, same in-place donation; see that
+    docstring). ``consts``/``sig`` ride along when the index carries
+    cross-term constants / fast-scan signatures so the four layouts can
+    never disagree on slot positions."""
+    pos_local, counts = list_positions(labels, n_lists + 1)
+    offs = jnp.concatenate([offsets, jnp.zeros((1,), jnp.int32)])
+    pos = pos_local + jnp.take(offs, labels)
+    buf = buf.at[labels, pos].set(codes, mode="drop")
+    idbuf = idbuf.at[labels, pos].set(ids.astype(jnp.int32), mode="drop")
+    if consts is not None:
+        cbuf = cbuf.at[labels, pos].set(consts, mode="drop")
+    if sig is not None:
+        sbuf = sbuf.at[labels, pos].set(sig, mode="drop")
+    return buf, idbuf, cbuf, sbuf, offsets + counts[:n_lists]
+
+
+def _extend_stream_f32(index: IvfPqIndex, reader, new_ids=None,
+                       res: Resources | None = None,
+                       split_factor: float | None = None) -> IvfPqIndex:
+    """The streamed twin of :func:`_extend_f32`: two passes over the
+    reader's chunks — assign, then residual/encode/scatter — instead of
+    one whole-corpus device array. Bit-equal to the in-core path: every
+    per-row quantity (ingest conversion, label, residual, signature, code,
+    cross-term constant) comes from the SAME helpers, none couples rows
+    across a batch, and the post-split gathers against ``np.repeat``ed
+    per-list arrays return exactly the parent values the in-core path
+    reads pre-split (split children share their parent's center, scale and
+    codebook). Device peak: index accumulators + two staged chunks + the
+    label/id vectors — CONSTANT in corpus rows beyond the index itself."""
+    from ..obs import build as build_metrics
+
+    res = res or default_resources()
+    _check_split_consts(index)
+    n_new, d = (int(s) for s in reader.shape)
+    expects(d == index.dim, "vector dim mismatch")
+    if index.data_kind in ("int8", "uint8"):
+        expects(str(reader.dtype) == index.data_kind,
+                "this index stores %s vectors; got %s", index.data_kind,
+                reader.dtype)
+    ingest = _stream_ingest(index.data_kind)
+    if new_ids is None:
+        new_ids = index.size + jnp.arange(n_new, dtype=jnp.int32)
+    else:
+        new_ids = jnp.asarray(new_ids, jnp.int32)
+        expects(int(new_ids.shape[0]) == n_new, "ids/vectors length mismatch")
+
+    pq_dim, pq_len = index.pq_dim, index.pq_len
+    cr = int(reader.chunk_rows)
+    emit = metrics.enabled()
+    stager = chunked.ChunkStager(cr, d, reader.dtype, kind="ivf_pq")
+    try:
+        # ---- pass A: per-chunk nearest-center assignment (labels stay
+        # device-resident; no per-chunk host syncs)
+        tile = _choose_tile(cr, index.n_lists, 1, res.workspace_bytes)
+        parts = []
+        with tracing.range("ivf_pq.extend.assign_stream"):
+            for start, block in reader.chunks():
+                xs = ingest(stager.stage(block))
+                parts.append(assign_to_lists(xs, index.centers,
+                                             index.metric, tile))
+                if emit:
+                    build_metrics.ooc_chunks().inc(1, kind="ivf_pq",
+                                                   stage="assign")
+        labels = jnp.concatenate(parts)[:n_new]  # drop pad-row garbage
+        del parts
+
+        # merge with existing list contents (old rows FIRST — stable
+        # ranks, and therefore the final layout, match the in-core twin)
+        n_old = 0
+        old_codes = old_ids = old_consts = old_sig = None
+        want_consts = (index.pq_split
+                       and index.metric != DistanceType.InnerProduct)
+        if index.capacity > 0 and index.size > 0:
+            old_mask = index.list_ids.reshape(-1) >= 0
+            old_codes = index.list_codes.reshape(-1, pq_dim)[old_mask]
+            old_ids = index.list_ids.reshape(-1)[old_mask]
+            old_labels = jnp.repeat(jnp.arange(index.n_lists),
+                                    index.capacity)[old_mask]
+            n_old = int(old_codes.shape[0])
+            labels = jnp.concatenate([old_labels.astype(jnp.int32), labels])
+            if want_consts:
+                old_consts = index.list_consts.reshape(-1)[old_mask]
+            if index.has_fast_scan:
+                old_sig = index.list_sig.reshape(
+                    -1, index.list_sig.shape[2])[old_mask]
+
+        # capacity policy over the FULL label vector — identical to the
+        # in-core call (ivf_pq never spatial-splits: sub-lists must share
+        # their parent's center for the codes to stay valid)
+        sf = index.split_factor if split_factor is None else split_factor
+        labels, rep, n_lists2, capacity, _ = bound_capacity(
+            labels, index.n_lists, sf)
+        centers, centers_rot = index.centers, index.centers_rot
+        codebooks = index.codebooks
+        list_scales, sig_scales = index.list_scales, index.sig_scales
+        if rep is not None:
+            centers = jnp.asarray(np.repeat(np.asarray(centers), rep,
+                                            axis=0))
+            centers_rot = jnp.asarray(np.repeat(np.asarray(centers_rot),
+                                                rep, axis=0))
+            if index.codebook_kind == "per_cluster":
+                codebooks = jnp.asarray(np.repeat(np.asarray(codebooks),
+                                                  rep, axis=0))
+            if index.scale_normed:
+                list_scales = jnp.asarray(
+                    np.repeat(np.asarray(list_scales), rep, axis=0))
+            if index.has_fast_scan:
+                sig_scales = jnp.asarray(
+                    np.repeat(np.asarray(sig_scales), rep, axis=0))
+
+        # ---- pass B: per-chunk residual -> encode -> scatter ----------
+        # Gathers run against the REPEATED arrays with POST-split labels:
+        # bitwise the parent values the in-core path uses pre-split (and
+        # repeat/compose commute for the split-codebook expansion).
+        per_cluster = index.codebook_kind == "per_cluster"
+        enc_cb = (_composed_codebooks(codebooks) if index.pq_split
+                  else codebooks)
+        n_codes = enc_cb.shape[-2]
+        enc_tile = max(min(cr, res.workspace_bytes
+                           // max(pq_dim * n_codes * 4, 1)), 8)
+        aniso_eta = (_default_aniso_eta(index.rot_dim)
+                     if index.codebook_loss == "anisotropic" else 0.0)
+        sig_w = index.list_sig.shape[2] if index.has_fast_scan else 0
+        buf = jnp.zeros((n_lists2, capacity, pq_dim), jnp.uint8)
+        idbuf = jnp.full((n_lists2, capacity), -1, jnp.int32)
+        cbuf = (jnp.zeros((n_lists2, capacity), jnp.float32) if want_consts
+                else jnp.zeros((n_lists2, 0), jnp.float32))
+        sbuf = (jnp.zeros((n_lists2, capacity, sig_w), jnp.uint8)
+                if index.has_fast_scan
+                else jnp.zeros((n_lists2, 0, 0), jnp.uint8))
+        offsets = jnp.zeros((n_lists2,), jnp.int32)
+        # transient ledger entry — the streamed build's device working set
+        # (released before the sealed index is accounted)
+        ooc_tok = obs_mem.account(
+            "build/ooc", name="ivf_pq",
+            device_bytes=int(buf.nbytes + idbuf.nbytes + cbuf.nbytes
+                             + sbuf.nbytes + offsets.nbytes + labels.nbytes
+                             + new_ids.nbytes),
+            owner=stager)
+        with tracing.range("ivf_pq.extend.fill_stream"):
+            if n_old > 0:
+                buf, idbuf, cbuf, sbuf, offsets = _fill_pq_chunk(
+                    buf, idbuf, cbuf, sbuf, offsets, old_codes, old_ids,
+                    labels[:n_old],
+                    old_consts if want_consts else None,
+                    old_sig if index.has_fast_scan else None,
+                    n_lists=n_lists2)
+                labels = labels[n_old:]
+            pad = -(-n_new // cr) * cr - n_new
+            lab_p = (jnp.concatenate(
+                [labels, jnp.full((pad,), n_lists2, jnp.int32)])
+                if pad else labels)
+            ids_p = (jnp.concatenate(
+                [new_ids, jnp.full((pad,), -1, jnp.int32)])
+                if pad else new_ids)
+            for start, block in reader.chunks():
+                xs = ingest(stager.stage(block))
+                st = jnp.int32(start)  # operand, not executable key
+                lab_c = lax.dynamic_slice_in_dim(lab_p, st, cr)
+                ids_c = lax.dynamic_slice_in_dim(ids_p, st, cr)
+                resid = (xs.astype(jnp.float32)
+                         - jnp.take(centers, lab_c, axis=0)
+                         ) @ index.rotation.T
+                sig_c = None
+                if index.has_fast_scan:
+                    sig_c = _encode_sig(resid,
+                                        jnp.take(sig_scales, lab_c),
+                                        index.fast_scan)
+                resid = resid.reshape(cr, pq_dim, pq_len)
+                if index.scale_normed:
+                    resid = resid / jnp.take(list_scales,
+                                             lab_c)[:, None, None]
+                codes_c = _encode(resid, enc_cb, lab_c,
+                                  per_cluster=per_cluster,
+                                  tile=min(enc_tile, 8192),
+                                  aniso_eta=aniso_eta)
+                consts_c = None
+                if want_consts:
+                    consts_c = _pq_cross_consts(codes_c, codebooks, lab_c,
+                                                per_cluster)
+                    if index.scale_normed:
+                        consts_c = (consts_c
+                                    * jnp.take(list_scales, lab_c) ** 2)
+                buf, idbuf, cbuf, sbuf, offsets = _fill_pq_chunk(
+                    buf, idbuf, cbuf, sbuf, offsets, codes_c, ids_c,
+                    lab_c, consts_c, sig_c, n_lists=n_lists2)
+                if emit:
+                    build_metrics.ooc_chunks().inc(1, kind="ivf_pq",
+                                                   stage="fill")
+        sizes = offsets
+        obs_mem.release(ooc_tok)
+    finally:
+        stager.release()
+    out = dataclasses.replace(
+        index, centers=centers, centers_rot=centers_rot, codebooks=codebooks,
+        list_codes=buf, list_ids=idbuf, list_sizes=sizes, list_consts=cbuf,
+        list_scales=list_scales, list_sig=sbuf, sig_scales=sig_scales,
+        split_factor=sf,
+    )
+    obs_mem.account_index(out)
+    if emit:
+        g = _quant_bytes_per_row()
+        g.set(pq_dim + 4, tier="pq")
         if index.has_fast_scan:
             g.set(index.list_sig.shape[2] + 4, tier="sig")
     return out

@@ -10,7 +10,8 @@ import functools
 
 from . import metrics
 
-__all__ = ["assignment_passes", "sampled_rows", "build_phase"]
+__all__ = ["assignment_passes", "sampled_rows", "build_phase",
+           "ooc_chunks", "ooc_staged_bytes", "ooc_chunk_rows"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -37,3 +38,31 @@ def build_phase():
         "raft_tpu_build_phase_seconds",
         "per-phase build walls (coarse trainer EM/final pass, CAGRA knn "
         "chunk loop / optimize)", unit="seconds")
+
+
+@functools.lru_cache(maxsize=None)
+def ooc_chunks():
+    return metrics.counter(
+        "raft_tpu_build_ooc_chunks_total",
+        "corpus chunks processed by the out-of-core streamed build, by "
+        "index kind and pipeline stage (assign = the label pass, fill = "
+        "the scatter/encode pass, materialize = chunked device upload "
+        "for dataset-resident kinds)")
+
+
+@functools.lru_cache(maxsize=None)
+def ooc_staged_bytes():
+    return metrics.counter(
+        "raft_tpu_build_ooc_staged_bytes_total",
+        "host bytes staged through the out-of-core build's "
+        "double-buffered chunk stager (core.chunked.ChunkStager); "
+        "resident staging bytes stay constant — this counts traffic",
+        unit="bytes")
+
+
+@functools.lru_cache(maxsize=None)
+def ooc_chunk_rows():
+    return metrics.gauge(
+        "raft_tpu_build_ooc_chunk_rows",
+        "rows per streamed-build chunk (the reader's chunk_rows after "
+        "clamping to the corpus)", unit="rows")

@@ -551,7 +551,8 @@ def _ivf_capacity(rows: int, n_lists: int, split_factor: float) -> int:
 
 def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
          dtype: str = "float32", storage: str = "hbm",
-         tier=None) -> dict:
+         tier=None, streamed: bool = False,
+         chunk_rows: int | None = None) -> dict:
     """Predict the long-lived (serve) device bytes and a coarse build peak
     for an index of ``kind`` over ``(rows, dim)`` data — the sizing half of
     memory-budget-aware planning (docs/serving.md "Capacity planning" for
@@ -573,10 +574,24 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
     budget gates price the DEVICE figure only; host bytes gate against
     ``Resources.host_budget_bytes``.
 
+    ``streamed=True`` prices the OUT-OF-CORE build instead (a
+    ``core.chunked.ChunkedReader`` corpus, ``chunk_rows`` per chunk —
+    default ``DEFAULT_CHUNK_ROWS``): the whole-corpus f32 working copy —
+    the very term streaming exists to remove — is replaced by two staged
+    chunks plus, for the IVF kinds, the device-resident label/id vectors
+    of the chunked scatter (8 B/row). ``host_peak_bytes`` turns nonzero:
+    the stager's two host buffers plus (IVF kinds) the trainset gather
+    off the reader — what the ``site="build_stream"`` admission gate
+    prices against ``Resources.host_budget_bytes`` BEFORE the coarse
+    trainer spends anything. Accuracy: within ±20% of the measured
+    ledger device peak of a chunked build at 100k rows (pinned in
+    tier-1).
+
     Returns ``{"kind", "rows", "dim", "index_bytes", "build_peak_bytes",
-    "breakdown": {array: bytes}, "tiers": {"device", "host", "disk"}}``
-    (``index_bytes`` stays the device figure — the budget-gate
-    comparator).
+    "host_peak_bytes", "breakdown": {array: bytes},
+    "tiers": {"device", "host", "disk"}}`` (``index_bytes`` stays the
+    device figure — the budget-gate comparator; ``host_peak_bytes`` is 0
+    unless ``streamed``).
     """
     from ..core.errors import expects
 
@@ -586,6 +601,7 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
     expects(item is not None, "unknown dtype %r", dtype)
     bk: dict[str, int] = {}
     f32_copy = rows * dim * 4  # the build's working copy / ingest view
+    train_rows = 0  # coarse-trainer subsample (IVF kinds; streamed host term)
 
     if kind == "brute_force":
         bk["dataset"] = rows * dim * item
@@ -604,6 +620,8 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
         bk["list_ids"] = n_lists * cap * 4
         bk["list_norms"] = n_lists * cap * 4
         bk["list_sizes"] = n_lists * 4
+        train_rows = min(max(int(rows * p.kmeans_trainset_fraction),
+                             n_lists), rows)
         build_peak = sum(bk.values()) + f32_copy
     elif kind == "ivf_pq":
         from ..distance.types import DistanceType, resolve_metric
@@ -647,8 +665,9 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
         # build peak: the f32 working copy plus the rotated-residual
         # trainset ((trainset, d_rot) f32) dominate the transients
         n_train = max(int(rows * p.kmeans_trainset_fraction), n_lists)
+        train_rows = min(n_train, rows)
         build_peak = (sum(bk.values()) + f32_copy
-                      + min(n_train, rows) * d_rot * 4)
+                      + train_rows * d_rot * 4)
     elif kind == "cagra":
         from ..neighbors import cagra
 
@@ -672,6 +691,29 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
             "ivf_flat, ivf_pq or cagra)")
     expects(storage in ("hbm", "tiered"),
             "plan() storage must be 'hbm' or 'tiered', got %r", storage)
+    host_peak = 0
+    if streamed:
+        from ..core.chunked import DEFAULT_CHUNK_ROWS
+
+        cr = min(int(chunk_rows or DEFAULT_CHUNK_ROWS), rows)
+        expects(cr >= 1, "plan() chunk_rows must be >= 1")
+        # device canonicalization caps staged chunks at 4 B/elt; two
+        # chunks are in flight at once (upload N+1 overlaps compute N)
+        staged_dev = 2 * cr * dim * min(item, 4)
+        host_peak = 2 * cr * dim * item
+        if kind in ("ivf_flat", "ivf_pq"):
+            # the chunked passes remove the whole-corpus working copy;
+            # the scatter keeps the full label + id vectors
+            # device-resident (int32 each) across both passes
+            build_peak = build_peak - f32_copy + staged_dev + rows * 8
+            # trainset gather off the reader lands a fresh host array
+            host_peak += train_rows * dim * item
+        else:
+            # dataset-resident kinds (brute_force, cagra) stream only the
+            # UPLOAD — the dataset still lands device-whole, so the peak
+            # keeps every in-core term and adds the staged chunks; what
+            # streaming removes is the host-side whole-corpus asarray
+            build_peak += staged_dev
     tiers = {"device": int(sum(bk.values())), "host": 0, "disk": 0}
     if storage == "tiered":
         raw = rows * dim * item  # the full-precision refine rows
@@ -681,7 +723,8 @@ def plan(kind: str, params=None, rows: int = 0, dim: int = 0, *,
         bk[f"tier_{cold}_rows"] = raw
     return {"kind": kind, "rows": rows, "dim": dim,
             "index_bytes": tiers["device"],
-            "build_peak_bytes": int(build_peak), "breakdown": bk,
+            "build_peak_bytes": int(build_peak),
+            "host_peak_bytes": int(host_peak), "breakdown": bk,
             "tiers": tiers}
 
 

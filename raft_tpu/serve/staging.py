@@ -48,7 +48,6 @@ pipeline tests and ``bench.py --serve-pipeline``).
 
 from __future__ import annotations
 
-import functools
 import threading
 
 import numpy as np
@@ -60,19 +59,17 @@ from ..obs import mem as obs_mem
 __all__ = ["StagingBuffers", "warm_staging"]
 
 
-@functools.cache
 def _stage_fns():
-    import jax
-
     # donated refill (pinned mode): the old slot is an OPERAND (the select
     # is degenerate but keeps the donated buffer aliasable as the output —
     # an identity body lets XLA pass the upload through and leaves the
-    # donation unused), so XLA reuses its memory for the staged output
-    import jax.numpy as jnp
+    # donation unused), so XLA reuses its memory for the staged output.
+    # The program itself now lives in core.chunked (the out-of-core build
+    # stager stages through the SAME donated identity), this module keeps
+    # its historical name for the serve-side callers.
+    from ..core.chunked import stage_fns
 
-    donated = jax.jit(lambda old, new: jnp.where(True, new, old),
-                      donate_argnums=(0,))
-    return donated
+    return stage_fns()
 
 
 class StagingBuffers:

@@ -601,12 +601,21 @@ class MutableIndex:
 
         store = None
         if dataset is not None:
-            store = np.asarray(dataset)
+            from ..core import chunked
+
+            # a ChunkedReader dataset (the out-of-core build's corpus)
+            # contributes its BACKING array — for an np.memmap that keeps
+            # the retained rows disk-backed end to end (TieredStore
+            # adopts the mmap; see stream/tiered.py), the corpus is
+            # never copied into RAM
+            store = (dataset.host_view() if chunked.is_reader(dataset)
+                     else np.asarray(dataset))
             expects(store.shape == (n, d),
                     "dataset= must be the sealed rows (%d, %d), got %s",
                     n, d, tuple(store.shape))
             if query_dtype == "float32":
-                store = np.asarray(store, np.float32)
+                if store.dtype != np.float32:
+                    store = np.asarray(store, np.float32)
             else:
                 expects(str(store.dtype) == query_dtype,
                         "dataset= dtype %s must match the serving dtype %s",
@@ -705,8 +714,10 @@ class MutableIndex:
         how tier residency migrates through the fold-and-swap)."""
         if rows is None or self._storage == "hbm":
             return rows
+        # rows pass RAW: TieredStore adopts an np.memmap in place (zero
+        # host bytes) — an asarray here would strip that provenance
         return TieredStore(
-            np.asarray(rows), name=self._cfg.name, shard=self._shard,
+            rows, name=self._cfg.name, shard=self._shard,
             epoch=epoch, policy=self._tier, device=self._cfg.device,
             residency=residency, clock=self._clock)
 
@@ -1287,7 +1298,8 @@ class MutableIndex:
         return out
 
     # -- compaction ---------------------------------------------------------
-    def compact(self, mode: str = "auto", res=None) -> dict:
+    def compact(self, mode: str = "auto", res=None, *,
+                ooc_chunk_rows: int | None = None) -> dict:
         """Fold the delta memtable (and, in rebuild mode, the tombstones)
         into a new sealed index and swap it in atomically.
 
@@ -1301,6 +1313,14 @@ class MutableIndex:
         delta, and every alive bit is re-read from the live tombstone state
         at swap time. Returns a report dict (mode, rows folded/reclaimed,
         wall seconds).
+
+        ``ooc_chunk_rows`` (rebuild mode only) routes the fold through
+        the out-of-core build path: the live rows feed the builder as a
+        ``core.chunked.ChunkedReader`` instead of one device-resident
+        array, so a rebuild's device peak stays at index + two staged
+        chunks — what lets a tiered/beyond-HBM index compact without
+        transiently re-materializing its corpus in HBM. Bit-equal to the
+        in-core fold (the streamed-build parity contract).
         """
         expects(mode in ("auto", "extend", "rebuild"),
                 "mode must be 'auto', 'extend' or 'rebuild', got %r", mode)
@@ -1314,6 +1334,9 @@ class MutableIndex:
                         else "rebuild")
             expects(mode == "rebuild" or cfg.kind in ("ivf_flat", "ivf_pq"),
                     "%s has no extend(); use mode='rebuild'", cfg.kind)
+            expects(ooc_chunk_rows is None or mode == "rebuild",
+                    "ooc_chunk_rows= streams the REBUILD fold; extend "
+                    "folds only the (small) delta — pass mode='rebuild'")
             t0 = time.perf_counter()
             with self._lock:
                 st = self._state
@@ -1351,9 +1374,19 @@ class MutableIndex:
                 new_id_map = np.concatenate([st.id_map[s_src], fold_gids])
                 new_store = live_rows
                 reclaimed = len(st.id_map) - len(s_src)
-                # committed input: a device-pinned shard rebuilds ON its
-                # own device (off the hot path either way)
-                x = _dev_put(cfg, live_rows)
+                if ooc_chunk_rows is not None:
+                    # out-of-core fold: the builder streams the live rows
+                    # chunk by chunk (all four kinds take readers) — no
+                    # whole-corpus device copy; a device pin is restored
+                    # on the sealed result below like any off-device build
+                    from ..core import chunked
+
+                    x = chunked.ChunkedReader(
+                        live_rows, chunk_rows=int(ooc_chunk_rows))
+                else:
+                    # committed input: a device-pinned shard rebuilds ON
+                    # its own device (off the hot path either way)
+                    x = _dev_put(cfg, live_rows)
                 if self._builder is not None:
                     new_sealed = self._builder(x, res=res)
                     got_kind, _ = _resolve_kind(new_sealed)

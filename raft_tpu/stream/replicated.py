@@ -710,7 +710,8 @@ class ReplicatedShard:
 
     # -- compaction / warm / durability --------------------------------------
     def compact(self, mode: str = "auto", res=None,
-                trigger: str | None = None) -> dict:
+                trigger: str | None = None,
+                ooc_chunk_rows: int | None = None) -> dict:
         """Fold every live twin (each through its ordinary off-lock
         fold+swap — readers keep serving whichever twin is not mid-swap,
         and the swap itself is atomic per twin). Report = the primary
@@ -719,7 +720,8 @@ class ReplicatedShard:
         single-index path."""
         reports = []
         for rep in self._live():
-            reports.append(rep.compact(mode=mode, res=res))
+            reports.append(rep.compact(mode=mode, res=res,
+                                       ooc_chunk_rows=ooc_chunk_rows))
         report = dict(reports[0])
         report["replica_wall_s"] = [rp["wall_s"] for rp in reports]
         if self._wal is not None and self._snapshot_path is not None:

@@ -302,9 +302,16 @@ class ShardedMutableIndex:
                  name: str = "default",
                  storage: str = "hbm", tier=None,
                  clock: Callable[[], float] = time.monotonic):
-        dataset = np.asarray(dataset)
+        from ..core import chunked
+
+        # a ChunkedReader corpus (the out-of-core build path) shards
+        # WITHOUT a whole-corpus RAM copy: each shard gathers only its
+        # own rows off the reader (memmap pages fault per shard)
+        stream = chunked.is_reader(dataset)
+        if not stream:
+            dataset = np.asarray(dataset)
         expects(dataset.ndim == 2, "dataset must be (rows, d)")
-        n = dataset.shape[0]
+        n = int(dataset.shape[0])
         n_shards = int(n_shards)
         expects(n_shards >= 1, "n_shards must be >= 1, got %d", n_shards)
         if ids is None:
@@ -376,8 +383,10 @@ class ShardedMutableIndex:
             wal_path = snap_path = None
             if self._wal_dir is not None:
                 snap_path, wal_path = self._shard_files(s)
+            rows_s = (dataset.take(rows_idx) if stream
+                      else dataset[rows_idx])
             self._shards.append(self._make_shard(
-                dataset[rows_idx], gids[rows_idx], s, n_shards,
+                rows_s, gids[rows_idx], s, n_shards,
                 wal=wal_path, snapshot_path=snap_path))
         self._next_id = int(gids.max()) + 1 if n else 0
         self._finish_init()
@@ -897,14 +906,18 @@ class ShardedMutableIndex:
         return int(np.argmax([p["delta_rows"] for p in per]))
 
     def compact(self, mode: str = "auto", shard: int | None = None,
-                res=None, trigger: str | None = None) -> dict:
+                res=None, trigger: str | None = None,
+                ooc_chunk_rows: int | None = None) -> dict:
         """Fold ONE shard (the most-due, or an explicit ``shard=``) through
         its ordinary fold+swap — the staggered step: the other shards keep
         serving their epochs untouched, and a Compactor loop folds shard
         after shard while its watermark stays tripped, republishing between
         folds (the Compactor forwards its tripped ``trigger`` so the pick
-        chases the right shard). Returns the shard's compaction report plus
-        ``shard`` and the aggregate ``epoch``."""
+        chases the right shard). ``ooc_chunk_rows`` forwards to the shard's
+        :meth:`MutableIndex.compact` — a rebuild fold then streams the
+        shard's live rows through the out-of-core build path instead of
+        one device-resident array. Returns the shard's compaction report
+        plus ``shard`` and the aggregate ``epoch``."""
         with self._compact_lock:
             if shard is None:
                 shard = self._pick_shard(mode, trigger)
@@ -912,7 +925,8 @@ class ShardedMutableIndex:
             expects(0 <= shard < len(self._shards),
                     "shard %d out of range (%d shards)", shard,
                     len(self._shards))
-            report = self._shards[shard].compact(mode=mode, res=res)
+            report = self._shards[shard].compact(
+                mode=mode, res=res, ooc_chunk_rows=ooc_chunk_rows)
             report["shard"] = shard
             report["shard_epoch"] = report["epoch"]
             agg = self.stats()

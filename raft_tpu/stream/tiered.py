@@ -344,6 +344,10 @@ class TieredStore:
                  policy: TierPolicy | None = None, device=None, res=None,
                  residency: str | None = None,
                  clock: Callable[[], float] = time.monotonic):
+        # mmap detection must see the RAW argument: np.asarray strips the
+        # memmap subclass (same memory, but the disk-backed provenance —
+        # what prices the rows at zero host bytes — would be lost)
+        raw = rows
         rows = np.asarray(rows)
         expects(rows.ndim == 2 and rows.shape[0] > 0,
                 "TieredStore rows must be (n>0, d)")
@@ -373,7 +377,18 @@ class TieredStore:
         self._slot_bytes = 0
 
         res = res or default_resources()
-        if self._policy.disk_path is not None:
+        self._mmap_adopted = False
+        if self._policy.disk_path is None and isinstance(raw, np.memmap):
+            # ADOPT the caller's mmap as the cold tier in place (the
+            # out-of-core build path: a ChunkedReader's backing memmap
+            # becomes the refine-row store without ever materializing a
+            # RAM copy). Pages are disk-backed, so the rows price zero
+            # host bytes — same rule as a disk_path store.
+            self._disk_file = None
+            self._mmap_adopted = True
+            self._rows = raw
+            host_gate = 0
+        elif self._policy.disk_path is not None:
             # the cold majority on disk: rows stream once into an mmap
             # whose pages the OS caches — the name+epoch suffix keeps a
             # compaction successor (or a shard/replica twin sharing the
@@ -421,7 +436,7 @@ class TieredStore:
                           detail=f"tiered store {name!r}")
         self._mem = obs_mem.account(
             "tier", name=name, shard=self._shard, epoch=self._epoch,
-            host=([] if self._disk_file is not None else [self._rows]),
+            host=([] if self._on_disk else [self._rows]),
             owner=self)
         _ensure_registered()
         _stores.add(self)
@@ -456,6 +471,12 @@ class TieredStore:
         return self._mirror is not None
 
     @property
+    def _on_disk(self) -> bool:
+        """Cold rows are disk-backed (own epoch file OR an adopted
+        mmap) — they price zero host bytes either way."""
+        return self._disk_file is not None or self._mmap_adopted
+
+    @property
     def mirror(self):
         """The promoted device copy (None while cold)."""
         return self._mirror
@@ -467,7 +488,7 @@ class TieredStore:
         the one scalar ``save()`` persists as the decided layout."""
         if self._mirror is not None:
             return "device"
-        return "disk" if self._disk_file is not None else "host"
+        return "disk" if self._on_disk else "host"
 
     def host_view(self) -> np.ndarray:
         """The cold row array (ndarray or memmap) — compaction folds,
@@ -483,8 +504,8 @@ class TieredStore:
                                   else 0)
         return {
             "device": int(dev),
-            "host": 0 if self._disk_file is not None else self.row_bytes,
-            "disk": self.row_bytes if self._disk_file is not None else 0,
+            "host": 0 if self._on_disk else self.row_bytes,
+            "disk": self.row_bytes if self._on_disk else 0,
         }
 
     def stats(self) -> dict:
@@ -513,7 +534,7 @@ class TieredStore:
                 dev.extend(ring)
         obs_mem.reaccount(
             self._mem, device=dev,
-            host=([] if self._disk_file is not None else [self._rows]))
+            host=([] if self._on_disk else [self._rows]))
 
     def _publish_gauges(self) -> None:
         """Publish the per-tier byte gauges + the global peak watermark.
@@ -701,7 +722,7 @@ class TieredStore:
         self._rows_fetched += int(ids.size)
         self._cold_fetches += 1
         self._h2d_bytes += int(gathered.nbytes)
-        src = "disk" if self._disk_file is not None else "host"
+        src = "disk" if self._on_disk else "host"
         if metrics._enabled:
             _c_fetches().inc(1, name=self._name, src=src)
             _c_h2d().inc(int(gathered.nbytes), name=self._name)
@@ -755,7 +776,7 @@ class TieredStore:
         dev = self._slot_upload(("oracle", c), block)
         self._fetch_wall_s += time.perf_counter() - t0
         self._h2d_bytes += int(block.nbytes)
-        src = "disk" if self._disk_file is not None else "host"
+        src = "disk" if self._on_disk else "host"
         if metrics._enabled:
             _c_fetches().inc(1, name=self._name, src=src)
             _c_h2d().inc(int(block.nbytes), name=self._name)

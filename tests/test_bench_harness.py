@@ -1019,6 +1019,57 @@ def test_tiered_row():
     assert isinstance(row.get("events"), dict), row
 
 
+def test_ooc_build_row():
+    """The --ooc-build bench row (ISSUE 19 acceptance): the same corpus
+    built in-core vs streamed off a temp-file memmap. The hard claims —
+    bit-equal indexes, streamed device peak inside plan(streamed)'s
+    ±20% envelope — are asserted INSIDE the row body (a violation
+    converts to an error row), so the small-scale twin coming back
+    clean IS the acceptance check; the row just has to carry the
+    attribution fields the compare.py gate and the round notes read."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_ooc_build(rows, n=20_000, d=32, n_lists=128, pq_dim=8,
+                         chunk_rows=4096, ncl=200)
+    row = rows[-1]
+    assert row["name"] == "ooc_build_100k" and "error" not in row, rows
+    assert row["bit_equal"] is True
+    assert row["recall"] == row["recall_incore"]  # bit-equal twins
+    assert row["n_chunks"] == 5
+    assert row["peak_dev_bytes"] > 0 and row["plan_dev_bytes"] > 0
+    assert row["peak_host_bytes"] > 0 and row["plan_host_bytes"] > 0
+    # the staging term is two chunks, independent of the corpus size
+    assert row["staging_dev_bytes"] == 2 * 4096 * 32 * 4
+    assert row["staging_dev_bytes"] < row["corpus_bytes"]
+    assert row["build_s"] > 0 and row["build_s_incore"] > 0
+    assert isinstance(row.get("events"), dict), row
+
+
+def test_ooc_build_flag_runs_only_the_ooc_row(monkeypatch):
+    """`bench.py --ooc-build` is the streamed-build iteration loop:
+    setup + the ooc row, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_ooc_build",
+        lambda rows: rows.append({"name": "ooc_build_100k", "recall": 1.0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--ooc-build"])
+        assert rc == 0 and calls == ["setup"]
+        assert any(r.get("name") == "ooc_build_100k"
+                   for r in bench._STATE["rows"])
+    finally:
+        bench._STATE["rows"].clear()
+
+
 def test_tiered_flag_runs_only_the_tiered_row(monkeypatch):
     """`bench.py --tiered` is the beyond-HBM iteration loop: setup + the
     tiered row, nothing else."""
