@@ -3342,6 +3342,248 @@ def _backend_or_exit(rows, timeout_s=150.0):
         os._exit(0)
 
 
+def _row_net_serve(rows, n=100_000, d=64, n_lists=512, k=10, n_probes=16,
+                   thread_ladder=(1, 4, 8), per_thread=150, max_batch=64,
+                   max_wait_us=2000.0, n_eval=512, ncl=500):
+    """Network front door A/B (ISSUE 20): the SAME published service
+    driven closed-loop in-process (``svc.search``) and over the loopback
+    wire (NetClient -> NetServer -> svc) at each rung of a concurrency
+    ladder. Same index, same flush programs, so recall over the wire must
+    equal the in-process measurement exactly (both fields gated by
+    bench/compare.py); the wire tax is the QPS ratio at the top rung; the
+    request p99 decomposes into wire/queue/flush from the serve
+    histograms plus the front door's wire-wall histogram. The whole
+    serving window — both paths, every rung — runs under compile
+    attribution and MUST be compile-free: publish() warmed the bucket
+    ladder and the wire path replays the same program set."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.net.client import NetClient
+    from raft_tpu.net.server import NetServer
+    from raft_tpu.obs import compile as obs_compile
+    from raft_tpu.obs import metrics as obs_metrics
+    from raft_tpu.serve import SearchService
+
+    _note("net: dataset")
+    dataset, qsets = _make_clustered(n, d, 2000, ncl, n_qsets=1, seed=29)
+    jax.block_until_ready([dataset] + qsets)
+    pool = np.asarray(qsets[0])
+    eval_q = pool[:n_eval]
+    _note("net: ground truth")
+    gt = _ground_truth(dataset, eval_q, k=k)
+
+    _note("net: ivf_flat build + publish")
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=n_lists, seed=0),
+                         dataset)
+    jax.block_until_ready(idx.list_data)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+
+    def serving(queries, k_):
+        return ivf_flat.search(sp, idx, queries, k_)
+
+    serving.kind, serving.dim, serving.query_dtype = "ivf_flat", d, "float32"
+    svc = SearchService(max_batch=max_batch, max_wait_us=max_wait_us,
+                        max_queue_rows=4096)
+    svc.publish("net", serving, k=k)  # the warm ladder IS the rehearsal
+
+    failures = []
+
+    def ladder(search_one):
+        qps = {}
+        for T in thread_ladder:
+            def worker(tid):
+                for j in range(per_thread):
+                    qi = (tid + j * T) % pool.shape[0]
+                    try:
+                        search_one(pool[qi:qi + 1])
+                    except Exception as e:  # pragma: no cover - fails row
+                        failures.append(
+                            f"{type(e).__name__}: {str(e)[:80]}")
+            ws = [threading.Thread(target=worker, args=(t,))
+                  for t in range(T)]
+            t0 = time.perf_counter()
+            for w in ws:
+                w.start()
+            for w in ws:
+                w.join(600)
+            qps[str(T)] = round(
+                T * per_thread / (time.perf_counter() - t0), 1)
+        return qps
+
+    def recall_of(search_batch):
+        got = []
+        for lo in range(0, n_eval, max_batch):
+            _, ids = search_batch(eval_q[lo:lo + max_batch])
+            got.append(np.asarray(ids))
+        return _recall(np.concatenate(got), gt)
+
+    with NetServer(svc) as srv:
+        cli = NetClient(f"http://127.0.0.1:{srv.port}")
+        # settle both paths' first flush OUTSIDE the attribution window:
+        # publish() compiled the ladder; these replay it from cache
+        svc.search("net", pool[:1], k)
+        cli.search("net", pool[:1], k)
+        with obs_compile.attribution() as rec:
+            _note("net: in-process ladder")
+            qps_in = ladder(lambda q: svc.search("net", q, k))
+            _note("net: wire ladder")
+            qps_wire = ladder(lambda q: cli.search("net", q, k))
+            recall_in = recall_of(lambda q: svc.search("net", q, k))
+            recall_wire = recall_of(lambda q: cli.search("net", q, k))
+    svc.shutdown()
+
+    p99 = None
+    if _STATE["metrics"]:
+        stream_label = f"net.k{k}"
+        p99 = {
+            "wire_total_ms": round(obs_metrics.quantile(
+                "raft_tpu_net_wire_seconds", 0.99,
+                route="/v1/search") * 1e3, 3),
+            "queue_ms": round(obs_metrics.quantile(
+                "raft_tpu_serve_queue_wait_seconds", 0.99,
+                stream=stream_label) * 1e3, 3),
+            "flush_ms": round(obs_metrics.quantile(
+                "raft_tpu_serve_flush_seconds", 0.99,
+                stream=stream_label) * 1e3, 3),
+        }
+    top = str(thread_ladder[-1])
+    assert not failures, failures[:3]
+    assert rec.cache_misses == 0, (
+        f"cold compiles on the serving window: {rec.cache_misses}")
+    rows.append({
+        "name": "net_serve_100k",
+        "qps": qps_wire[top],
+        "qps_inproc": qps_in[top],
+        "wire_tax": (round(qps_wire[top] / qps_in[top], 3)
+                     if qps_in[top] else None),
+        "qps_by_threads": {"inproc": qps_in, "wire": qps_wire},
+        "recall_inproc": round(recall_in, 4),
+        "recall_wire": round(recall_wire, 4),
+        "recall_gap": round(recall_wire - recall_in, 4),
+        "p99_decomp": p99,
+        "compile_s": round(rec.compile_s, 3),
+        "cache_misses": rec.cache_misses,
+        "threads": list(thread_ladder), "max_batch": max_batch,
+        "k": k, "n_probes": n_probes, "n_lists": n_lists,
+    })
+
+
+def _row_net_kill_worker(rows, n=100_000, d=64, k=10, threads=6,
+                         duration_s=8.0, kill_after_s=3.0, n_eval=256,
+                         max_batch=64):
+    """Mesh availability over the wire (ISSUE 20): a 2-shard x 2-replica
+    ProcessMesh serves a closed loop through the network front door; one
+    worker process is SIGKILLed mid-load. The router's breaker must turn
+    the kill into strike->fence->failover with ZERO failed queries (the
+    PR 11 semantics crossing process boundaries), post-kill recall must
+    stay exact (brute-force workers — any drop means the merge lost a
+    shard's candidates), and the surviving fleet reports zero cold
+    compiles: each worker warmed its bucket ladder at boot, before the
+    front door ever saw traffic."""
+    import threading
+
+    import numpy as np
+
+    from raft_tpu.net.client import NetClient
+    from raft_tpu.net.mesh import MeshSpec, ProcessMesh
+    from raft_tpu.net.server import NetServer
+    from raft_tpu.obs import events as obs_events
+
+    _note("net-kill: dataset")
+    rng = np.random.default_rng(31)
+    dataset = rng.standard_normal((n, d)).astype(np.float32)
+    pool = rng.standard_normal((2000, d)).astype(np.float32)
+    eval_q = pool[:n_eval]
+    gt = _ground_truth(dataset, eval_q, k=k)
+
+    _note("net-kill: boot 2x2 worker mesh (spawn + warm ladders)")
+    seq0 = obs_events.last_seq()
+    t0 = time.perf_counter()
+    mesh = ProcessMesh(dataset, spec=MeshSpec(
+        n_shards=2, n_replicas=2, name="corpus", ks=(k,),
+        max_batch=max_batch))
+    boot_s = time.perf_counter() - t0
+
+    failures, served = [], [0]
+    lock = threading.Lock()
+    done = threading.Event()
+    kill_box = {}
+    try:
+        with NetServer(mesh, stats=mesh.stats) as srv:
+            cli = NetClient(f"http://127.0.0.1:{srv.port}")
+
+            def reader(tid):
+                cnt, j = 0, 0
+                while not done.is_set():
+                    qi = (tid + j * threads) % pool.shape[0]
+                    j += 1
+                    try:
+                        cli.search("corpus", pool[qi:qi + 1], k)
+                        cnt += 1
+                    except Exception as e:  # pragma: no cover - fails row
+                        with lock:
+                            failures.append(
+                                f"{type(e).__name__}: {str(e)[:80]}")
+                with lock:
+                    served[0] += cnt
+
+            _note(f"net-kill: {threads}-thread load, kill s0r0 at "
+                  f"{kill_after_s:.0f}s")
+            ws = [threading.Thread(target=reader, args=(t,))
+                  for t in range(threads)]
+            t_load = time.perf_counter()
+            for w in ws:
+                w.start()
+            time.sleep(kill_after_s)
+            kill_box["pid"] = mesh.kill_worker(0, 0)
+            kill_box["at_s"] = round(time.perf_counter() - t_load, 2)
+            time.sleep(max(duration_s - kill_after_s, 1.0))
+            done.set()
+            for w in ws:
+                w.join(60)
+            load_s = time.perf_counter() - t_load
+
+            got = []
+            for lo in range(0, n_eval, max_batch):
+                _, ids = cli.search("corpus", eval_q[lo:lo + max_batch], k)
+                got.append(np.asarray(ids))
+            recall_after = _recall(np.concatenate(got), gt)
+            st = mesh.stats()
+            health = mesh.health()
+    finally:
+        mesh.close()
+
+    kinds = [e["kind"] for e in obs_events.query(since_seq=seq0)]
+    failovers = kinds.count("net_worker_failover")
+    assert not failures, (
+        f"{len(failures)} failed queries: {failures[:3]}")
+    assert failovers >= 1, "the kill produced no observed failover"
+    assert st["cache_misses"] == 0, (
+        f"cold compiles in the surviving fleet: {st['cache_misses']}")
+    rows.append({
+        "name": "net_kill_worker_100k",
+        "qps": round(served[0] / load_s, 1),
+        "queries": served[0],
+        "failed": len(failures),
+        "recall_after_kill": round(recall_after, 4),
+        "failovers": failovers,
+        "fenced": kinds.count("net_worker_fenced"),
+        "kill": {"shard": 0, "replica": 0, "pid": kill_box["pid"],
+                 "at_s": kill_box["at_s"]},
+        "healthy_by_shard": [g["healthy"] for g in health["shards"]],
+        "fleet": {"compile_s": st["compile_s"],
+                  "cache_misses": st["cache_misses"],
+                  "workers_reporting": st["workers"]},
+        "boot_s": round(boot_s, 1),
+        "shards": 2, "replicas": 2, "threads": threads,
+        "max_batch": max_batch, "k": k,
+    })
+
+
 def _row_guard(rows, name, fn, timeout_s=None, _exit=None):
     """Run one row's body under a watchdog (VERDICT r3 weak #6).
 
@@ -3509,6 +3751,15 @@ def _run(rows):
                    lambda: _row_quant_funnel(rows))
         _emit()
 
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "net_serve_100k", lambda: _row_net_serve(rows))
+        _emit()
+
+    if _elapsed() < SOFT_BUDGET_S:
+        _row_guard(rows, "net_kill_worker_100k",
+                   lambda: _row_net_kill_worker(rows))
+        _emit()
+
     lid_box = {}
     if _elapsed() < SOFT_BUDGET_S:
         _row_guard(rows, "ivf_pq_1m_lid_pq4x64_r4",
@@ -3662,6 +3913,16 @@ def main(argv=None):
             _setup(rows)
             _row_guard(rows, "tune_smoke_10k",
                        lambda: _row_tune_smoke(rows))
+        elif "--net-serve" in argv:
+            # network front door only (ISSUE 20): the iteration loop for
+            # wire/mesh parameters — the in-process vs over-the-wire
+            # closed-loop A/B at identical recall, then the mid-load
+            # worker kill with the zero-failed-queries failover proof
+            _setup(rows)
+            _row_guard(rows, "net_serve_100k",
+                       lambda: _row_net_serve(rows))
+            _row_guard(rows, "net_kill_worker_100k",
+                       lambda: _row_net_kill_worker(rows))
         elif "--serve-pipeline" in argv:
             # host-free flush pipeline A/B only (ISSUE 12): the iteration
             # loop for pipeline_depth / staging parameters — sync vs

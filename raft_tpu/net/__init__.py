@@ -1,0 +1,63 @@
+"""raft_tpu.net — the network front door: wire surface + process mesh.
+
+ROADMAP item 5. Three layers, each usable alone:
+
+- :mod:`~raft_tpu.net.wire` — explicit schemas for every serve-path
+  message (query batch, candidate set, publish/flush control) plus the
+  admission-taxonomy ↔ HTTP status mapping. Arrays ride base64-encoded
+  raw buffers with dtype/shape, never Python floats; errors ride
+  structured JSON bodies that reconstruct the exact exception type with
+  fields intact on the client.
+- :class:`~raft_tpu.net.server.NetServer` /
+  :class:`~raft_tpu.net.client.NetClient` — a zero-dependency HTTP/JSON
+  front end over :class:`raft_tpu.serve.SearchService` and the client
+  library that wraps :func:`raft_tpu.serve.submit_with_retry`'s
+  backoff/deadline discipline around the wire calls. Deadline budgets
+  and request ids ride headers so one trace spans wire→queue→flush in
+  the request log.
+- :class:`~raft_tpu.net.mesh.ProcessMesh` — shard groups owned by
+  separate worker *processes* behind a router, the scatter-gather merge
+  crossing process boundaries with candidates-only on the wire (k ids +
+  distances per part, never raw rows). Replica groups are placed across
+  processes, so killing a worker is a strike→fence→failover event, not
+  an outage; each worker rehearses the warm-before-flip publish ladder
+  so the wire path serves with zero cold compiles.
+
+The shared stdlib server plumbing lives in :mod:`~raft_tpu.net._httpd`
+(also backing the obs exporter — one server pattern, not two). Heavy
+submodules are imported lazily so ``obs.http → net._httpd`` never drags
+the serve stack (or jax) into an import cycle.
+
+See docs/serving.md § "Network front door".
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from ._httpd import Httpd, Request, Response, json_response
+
+__all__ = ["Httpd", "Request", "Response", "json_response",
+           "wire", "NetServer", "NetClient", "ProcessMesh", "MeshSpec"]
+
+_LAZY = {
+    "NetServer": ("server", "NetServer"),
+    "NetClient": ("client", "NetClient"),
+    "ProcessMesh": ("mesh", "ProcessMesh"),
+    "MeshSpec": ("mesh", "MeshSpec"),
+    "wire": ("wire", None),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        modname, attr = _LAZY[name]
+        mod = importlib.import_module(f".{modname}", __name__)
+        val = mod if attr is None else getattr(mod, attr)
+        globals()[name] = val
+        return val
+    raise AttributeError(f"module 'raft_tpu.net' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -123,6 +123,12 @@ KINDS = {
     "control/action_failed": "error",
     "control/degraded": "warning",
     "control/restored": "info",
+    # process-mesh worker breakers (net/mesh.py) — the cross-process
+    # mirror of the replica_* family: a killed worker process fences,
+    # the scatter fails over to its twin in the same call
+    "net_worker_fenced": "warning",
+    "net_worker_unfenced": "info",
+    "net_worker_failover": "warning",
     # the recorder's own breadcrumb (this module)
     "flight_recorder": "info",
 }

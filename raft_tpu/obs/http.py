@@ -38,6 +38,11 @@ value of the 404 outweighs the curl convenience). Nothing starts unless
 the process asks: no port is opened at import, and the exporter holds no
 lock while rendering beyond the registry's own snapshot lock.
 
+The server plumbing itself (routing table, 404 contract, ephemeral-port
+bind, clean shutdown) is the shared :class:`raft_tpu.net._httpd.Httpd` —
+the same stack that serves the net front door, one server pattern, not
+two.
+
     from raft_tpu import obs
 
     exp = obs.start_http_exporter(9100, slo=tracker, request_log=rlog)
@@ -48,18 +53,15 @@ lock while rendering beyond the registry's own snapshot lock.
 
 from __future__ import annotations
 
-import json
 import threading
-import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..net._httpd import Httpd, Response, json_response
 from . import metrics
 
 __all__ = ["MetricsExporter", "start_http_exporter", "stop_http_exporter"]
 
 # Prometheus text exposition content type (version 0.0.4 is the text format)
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
-_JSON_TYPE = "application/json; charset=utf-8"
 
 _lock = threading.Lock()
 _active: "MetricsExporter | None" = None
@@ -90,147 +92,109 @@ def _fold_replica_health(code: int, body: dict, h: dict) -> tuple[int, dict]:
 
 
 class MetricsExporter:
-    """One running exporter: a ThreadingHTTPServer on a daemon thread.
-    ``slo``/``request_log`` are optional sources for ``/healthz`` and
-    ``/debug/requests`` (see module doc)."""
+    """One running exporter: a routed :class:`~raft_tpu.net._httpd.Httpd`
+    on a daemon thread. ``slo``/``request_log`` are optional sources for
+    ``/healthz`` and ``/debug/requests`` (see module doc)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: metrics.Registry | None = None,
                  slo=None, request_log=None, replicas=None,
                  controller=None):
-        reg = registry or metrics.default_registry()
-        exporter = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def _send(self, code: int, ctype: str, body: bytes) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):  # noqa: N802 - http.server API
-                path = self.path.split("?", 1)[0]
-                if path == "/metrics":
-                    self._send(200, _CONTENT_TYPE,
-                               reg.to_prometheus().encode())
-                elif path == "/healthz":
-                    if exporter.slo is None:
-                        code, body = 200, {"status": "ready", "slo": None,
-                                           "note": "no SLO tracker attached"}
-                    else:
-                        code, body = exporter.slo.healthz()
-                    if exporter.replicas is not None:
-                        code, body = _fold_replica_health(
-                            code, dict(body), exporter.replicas.health())
-                    if exporter.controller is not None:
-                        # compact controller state rides the health body
-                        # (informational — an automated actuation is not
-                        # degradation; its failures journal as
-                        # control/action_failed)
-                        st = exporter.controller.status()
-                        body = dict(body)
-                        body["control"] = {
-                            "enabled": st["enabled"],
-                            "dry_run": st["dry_run"],
-                            "inflight": st["inflight"],
-                            "last_action": st["last_action"],
-                            "degraded": st["degraded"],
-                        }
-                    self._send(code, _JSON_TYPE,
-                               json.dumps(body, default=float).encode())
-                elif path == "/debug/mem":
-                    from . import mem as obs_mem
-
-                    self._send(200, _JSON_TYPE, json.dumps(
-                        obs_mem.debug_payload(), default=float).encode())
-                elif path == "/debug/events":
-                    from . import events as obs_events
-
-                    qs = urllib.parse.parse_qs(
-                        urllib.parse.urlsplit(self.path).query)
-
-                    def _q(key):
-                        vals = qs.get(key)
-                        return vals[-1] if vals else None
-
-                    try:
-                        since = int(_q("since_seq") or 0)
-                        limit = (int(_q("limit"))
-                                 if _q("limit") is not None else None)
-                    except ValueError:
-                        self._send(400, _JSON_TYPE, json.dumps(
-                            {"error": "since_seq and limit must be "
-                                      "integers"}).encode())
-                        return
-                    evs = obs_events.query(
-                        kind=_q("kind"), severity=_q("severity"),
-                        component=_q("component"), name=_q("name"),
-                        since_seq=since, limit=limit)
-                    self._send(200, _JSON_TYPE, json.dumps(
-                        {"events": evs,
-                         "last_seq": obs_events.last_seq(),
-                         "counts_by_kind": obs_events.counts_by_kind()},
-                        default=float).encode())
-                elif path == "/debug/control":
-                    if exporter.controller is None:
-                        self._send(404, _JSON_TYPE, json.dumps(
-                            {"error": "no controller attached — pass "
-                                      "controller= to the exporter"}
-                        ).encode())
-                    else:
-                        from . import events as obs_events
-
-                        self._send(200, _JSON_TYPE, json.dumps(
-                            {"controller": exporter.controller.status(),
-                             "recent": obs_events.query(
-                                 component="control", limit=50)},
-                            default=float).encode())
-                elif path == "/debug/requests":
-                    if exporter.request_log is None:
-                        self._send(404, _JSON_TYPE, json.dumps(
-                            {"error": "no request log attached — pass "
-                                      "request_log= to the exporter"}
-                        ).encode())
-                    else:
-                        self._send(200, _JSON_TYPE, json.dumps(
-                            exporter.request_log.to_json(),
-                            default=float).encode())
-                else:
-                    # explicit routing: unknown paths fail loudly instead of
-                    # silently answering a typo'd scrape config with metrics
-                    self._send(404, "text/plain; charset=utf-8",
-                               (f"unknown path {path!r}; endpoints: "
-                                "/metrics, /healthz, /debug/requests, "
-                                "/debug/mem, /debug/events, "
-                                "/debug/control\n").encode())
-
-            def log_message(self, fmt, *args):
-                # scrapes every few seconds must not spam stderr; the
-                # request count is observable from the scraper side
-                pass
-
+        self._registry = registry or metrics.default_registry()
         self.slo = slo
         self.request_log = request_log
         self.replicas = replicas
         self.controller = controller
-        self._server = ThreadingHTTPServer((host, int(port)), Handler)
-        self._server.daemon_threads = True
+        # registration order is the 404 listing order
+        self._server = Httpd({
+            ("GET", "/metrics"): self._metrics,
+            ("GET", "/healthz"): self._healthz,
+            ("GET", "/debug/requests"): self._debug_requests,
+            ("GET", "/debug/mem"): self._debug_mem,
+            ("GET", "/debug/events"): self._debug_events,
+            ("GET", "/debug/control"): self._debug_control,
+        }, port=port, host=host, name="raft-obs-exporter")
         self.host = host
-        self.port = int(self._server.server_address[1])
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            name=f"raft-obs-exporter-{self.port}", daemon=True)
-        self._thread.start()
+        self.port = self._server.port
 
+    # -- route handlers ------------------------------------------------------
+    def _metrics(self, req) -> Response:
+        return Response(200, self._registry.to_prometheus().encode(),
+                        _CONTENT_TYPE)
+
+    def _healthz(self, req) -> Response:
+        if self.slo is None:
+            code, body = 200, {"status": "ready", "slo": None,
+                               "note": "no SLO tracker attached"}
+        else:
+            code, body = self.slo.healthz()
+        if self.replicas is not None:
+            code, body = _fold_replica_health(
+                code, dict(body), self.replicas.health())
+        if self.controller is not None:
+            # compact controller state rides the health body
+            # (informational — an automated actuation is not degradation;
+            # its failures journal as control/action_failed)
+            st = self.controller.status()
+            body = dict(body)
+            body["control"] = {
+                "enabled": st["enabled"],
+                "dry_run": st["dry_run"],
+                "inflight": st["inflight"],
+                "last_action": st["last_action"],
+                "degraded": st["degraded"],
+            }
+        return json_response(code, body)
+
+    def _debug_mem(self, req) -> Response:
+        from . import mem as obs_mem
+
+        return json_response(200, obs_mem.debug_payload())
+
+    def _debug_events(self, req) -> Response:
+        from . import events as obs_events
+
+        try:
+            since = int(req.param("since_seq") or 0)
+            limit = (int(req.param("limit"))
+                     if req.param("limit") is not None else None)
+        except ValueError:
+            return json_response(400, {"error": "since_seq and limit must "
+                                                "be integers"})
+        evs = obs_events.query(
+            kind=req.param("kind"), severity=req.param("severity"),
+            component=req.param("component"), name=req.param("name"),
+            since_seq=since, limit=limit)
+        return json_response(200, {"events": evs,
+                                   "last_seq": obs_events.last_seq(),
+                                   "counts_by_kind":
+                                       obs_events.counts_by_kind()})
+
+    def _debug_control(self, req) -> Response:
+        if self.controller is None:
+            return json_response(404, {"error": "no controller attached — "
+                                                "pass controller= to the "
+                                                "exporter"})
+        from . import events as obs_events
+
+        return json_response(200, {"controller": self.controller.status(),
+                                   "recent": obs_events.query(
+                                       component="control", limit=50)})
+
+    def _debug_requests(self, req) -> Response:
+        if self.request_log is None:
+            return json_response(404, {"error": "no request log attached — "
+                                                "pass request_log= to the "
+                                                "exporter"})
+        return json_response(200, self.request_log.to_json())
+
+    # -- lifecycle -----------------------------------------------------------
     def stop(self, timeout_s: float = 5.0) -> None:
         """Shut the listener down and join the serving thread. Idempotent."""
         server, self._server = self._server, None
         if server is None:
             return
-        server.shutdown()
-        server.server_close()
-        self._thread.join(timeout_s)
+        server.stop(timeout_s)
 
     def __enter__(self) -> "MetricsExporter":
         return self

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import os
 import threading
 import time
 from typing import Callable
@@ -61,12 +62,16 @@ _tls = threading.local()
 
 
 class _Collector:
-    __slots__ = ("spans", "notes", "pre")
+    __slots__ = ("spans", "notes", "pre", "pid")
 
     def __init__(self):
         self.spans: dict[str, float] = {}
         self.notes: dict[str, object] = {}
         self.pre = ""  # active span/note name prefix (see `prefix`)
+        # owning process: `collect(resume=)` is a SINGLE-PROCESS contract
+        # (thread-local handoff, never concurrent) — a collector carried
+        # across a fork/spawn boundary must not be resumed there
+        self.pid = os.getpid()
 
 
 class collect:
@@ -79,15 +84,29 @@ class collect:
     ``resume=`` re-opens an EXISTING collector instead of a fresh one —
     how the pipelined batcher's completion stage (possibly on another
     thread, never concurrently with dispatch) lands its spans on the same
-    batch's trace as the dispatch-side ones."""
+    batch's trace as the dispatch-side ones. The handoff contract is
+    same-process only: dispatch and completion are threads of one service.
+    A collector that crossed a process boundary (fork-inherited, or
+    unpickled in a mesh worker's response path) must NOT be mutated —
+    the parent may still complete the same batch, and two processes
+    appending to one span dict corrupts the trace. ``__enter__`` checks
+    the collector's owning pid and degrades to a FRESH collector (noted
+    ``resume_degraded: cross-process``) so wire traces lose the resumed
+    spans instead of corrupting them."""
 
     def __init__(self, resume: _Collector | None = None):
         self._resume = resume
 
     def __enter__(self) -> _Collector:
         self._prev = getattr(_tls, "collector", None)
-        _tls.collector = (self._resume if self._resume is not None
-                          else _Collector())
+        resume = self._resume
+        if resume is not None and resume.pid != os.getpid():
+            # cross-process resume: the collector belongs to another
+            # process's trace — start fresh, mark the degrade
+            resume = None
+        _tls.collector = resume if resume is not None else _Collector()
+        if resume is None and self._resume is not None:
+            _tls.collector.notes["resume_degraded"] = "cross-process"
         return _tls.collector
 
     def __exit__(self, *exc) -> None:
@@ -172,17 +191,43 @@ class RequestLog:
         self._exemplars: dict[str, dict] = {}
 
     # -- lifecycle -----------------------------------------------------------
-    def begin(self, stream: str, rows: int) -> str:
-        """Mint a request id at admission and record it in flight."""
+    def begin(self, stream: str, rows: int, *,
+              rid: str | None = None) -> str:
+        """Mint a request id at admission and record it in flight.
+
+        ``rid=`` adopts an EXTERNALLY minted id instead (the net front
+        door threads the wire request id — ``X-Raft-Request-Id`` — here,
+        so one trace spans wire→queue→flush under the id the client
+        logged). Adopted ids are recorded as given; uniqueness is the
+        caller's contract (a reused id overwrites the pending entry)."""
         now = self._clock()
         with self._lock:
-            self._next += 1
-            rid = f"req-{self._next:08d}"
+            if rid is None:
+                self._next += 1
+                rid = f"req-{self._next:08d}"
+            else:
+                rid = str(rid)
             self._pending[rid] = {"rid": rid, "stream": stream,
                                   "rows": int(rows), "admitted_at": now}
             while len(self._pending) > self.in_flight_capacity:
                 self._pending.pop(next(iter(self._pending)))
             return rid
+
+    def attach_span(self, rid: str | None, name: str,
+                    seconds: float) -> None:
+        """Attach a span to an ALREADY COMPLETED request's ring entry —
+        how the net front door lands the ``wire`` span (measured around
+        the whole submit→resolve window, so it bounds queue+flush) on a
+        trace after the batcher completed it. Searches the ring newest-
+        first; a no-op when the rid has been evicted (or ``None``), so
+        wire tracing degrades instead of raising."""
+        if rid is None:
+            return
+        with self._lock:
+            for entry in reversed(self._ring):
+                if entry["rid"] == rid:
+                    entry["spans_ms"][name] = round(float(seconds) * 1e3, 4)
+                    return
 
     def complete(self, rid: str | None, *, stream: str, rows: int,
                  spans: dict, bucket: int | None = None, notes: dict = None,
@@ -214,7 +259,18 @@ class RequestLog:
             _c_logged().inc(1, stream=stream, outcome=outcome)
 
     # -- read side -----------------------------------------------------------
+    def get(self, rid: str) -> dict | None:
+        """The completed ring entry for ``rid`` (newest first), or ``None``
+        when it never completed / was evicted — the net front door's span
+        lookup, deliberately miss-tolerant."""
+        with self._lock:
+            for entry in reversed(self._ring):
+                if entry["rid"] == rid:
+                    return dict(entry)
+        return None
+
     def recent(self, n: int = 50) -> list[dict]:
+        """The most recent completed requests, oldest first."""
         with self._lock:
             return list(self._ring)[-int(n):]
 

@@ -65,7 +65,17 @@ def submit_with_retry(service, name: str, queries, k: int = 10, *,
     :class:`DeadlineExceededError` immediately instead of sleeping into
     it. ``DeadlineExceededError`` (and every other error) propagates on
     the first occurrence — a spent deadline must never burn more queue
-    slots. ``clock``/``sleep``/``rng`` are injectable for tests."""
+    slots. ``clock``/``sleep``/``rng`` are injectable for tests.
+
+    A refusal carrying a ``retry_after_s`` attribute — the server's own
+    drain estimate, set from queue depth by the net front door's
+    ``Retry-After`` header (:meth:`SearchService.retry_after_hint`) —
+    overrides the exponential schedule for THAT attempt: the client
+    sleeps the hint scaled by a jitter in ``[1, 1 + jitter]`` (upward
+    only — never less than the server asked, uncapped by
+    ``max_backoff_s`` because the server's estimate beats the client's
+    blind doubling). Refusals without the hint fall back to the
+    exponential backoff above; the deadline check applies either way."""
     expects(max_attempts >= 1, "max_attempts must be >= 1, got %d",
             max_attempts)
     expects(0.0 <= jitter <= 1.0, "jitter must be in [0, 1], got %g", jitter)
@@ -75,13 +85,18 @@ def submit_with_retry(service, name: str, queries, k: int = 10, *,
         remaining = None if deadline is None else deadline - clock()
         try:
             fut = service.submit(name, queries, k, timeout_s=remaining)
-        except OverloadedError:
+        except OverloadedError as exc:
             if attempt + 1 >= int(max_attempts):
                 if metrics._enabled:
                     _c_retries().inc(1, name=name, outcome="exhausted")
                 raise
-            delay = min(base_s * (2.0 ** attempt), max_backoff_s)
-            delay *= 1.0 - jitter + 2.0 * jitter * rng.random()
+            hint = getattr(exc, "retry_after_s", None)
+            if hint is not None and float(hint) > 0:
+                # server-supplied drain estimate: jitter upward only
+                delay = float(hint) * (1.0 + jitter * rng.random())
+            else:
+                delay = min(base_s * (2.0 ** attempt), max_backoff_s)
+                delay *= 1.0 - jitter + 2.0 * jitter * rng.random()
             if deadline is not None and clock() + delay >= deadline:
                 raise DeadlineExceededError(
                     f"deadline would expire during retry backoff "

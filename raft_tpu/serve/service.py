@@ -384,7 +384,8 @@ class SearchService:
         return flush
 
     def submit(self, name: str, queries, k: int = 10, *,
-               timeout_s: float | None = None) -> Future:
+               timeout_s: float | None = None,
+               rid: str | None = None) -> Future:
         """Enqueue a ``(rows, d)`` query block (rows <= ``max_batch``) for
         index ``name`` at width ``k``; returns a Future resolving to
         ``(distances (rows, k), ids (rows, k))``.
@@ -394,6 +395,11 @@ class SearchService:
         :class:`DeadlineExceededError` when ``timeout_s <= 0``. A queued
         request whose deadline passes before it is drained fails its future
         with :class:`DeadlineExceededError` without touching the device.
+
+        ``rid=`` adopts an externally minted request id for the trace
+        (the net front door passes the wire ``X-Raft-Request-Id`` so one
+        trace spans wire→queue→flush); ignored when no request log is
+        attached.
 
         Queries are staged as host NumPy (submit never touches the device;
         the flush dispatches one padded bucket-shaped array) and results
@@ -448,7 +454,7 @@ class SearchService:
             raise OverloadedError(
                 f"queue at {self._rows.value()}/{self.max_queue_rows} rows; "
                 f"request of {n} refused")
-        rid = (self._request_log.begin(f"{name}.k{k}", n)
+        rid = (self._request_log.begin(f"{name}.k{k}", n, rid=rid)
                if self._request_log is not None else None)
         try:
             fut = b.submit(q, deadline=deadline, rid=rid)
@@ -536,6 +542,20 @@ class SearchService:
 
     def queue_depth(self) -> int:
         return self._rows.value()
+
+    def retry_after_hint(self) -> float:
+        """How long an admission-refused caller should wait before
+        retrying, from the CURRENT queue depth: the queued rows drain in
+        ``ceil(depth / max_batch)`` flushes of at most ``max_wait_us``
+        each, so that product is when the queue has provably had a chance
+        to empty. Floored at one flush window, capped at 250 ms so a
+        momentarily deep queue never tells clients to go away for whole
+        seconds (the queue drains far faster than it fills under shed
+        load). The net front door serves this as ``Retry-After`` on 429s;
+        :func:`~raft_tpu.serve.submit_with_retry` prefers it over blind
+        exponential backoff."""
+        flushes = max(1, -(-self._rows.value() // self.max_batch))
+        return min(0.25, flushes * (self.max_wait_us * 1e-6))
 
     def staging_stats(self) -> dict:
         """Per-stream staging-buffer counters (uploads, donation frees,

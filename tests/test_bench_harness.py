@@ -1362,3 +1362,84 @@ def test_controller_flag_runs_only_the_controller_rows(monkeypatch):
         assert {"controller_drift_100k", "controller_ramp_100k"} <= names
     finally:
         bench._STATE["rows"].clear()
+
+
+# ---------------------------------------------------------------------------
+# bench.py --net-serve — the network front-door rows (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def test_net_serve_row():
+    """The --net-serve A/B row (ISSUE 20 acceptance): the same published
+    service driven in-process and over the loopback wire — recall must be
+    IDENTICAL across the two paths (same index, same flush programs), the
+    QPS ladder and the wire/queue/flush p99 decomposition ride the row,
+    and the serving window is compile-free. The shrunk-scale twin must
+    come back clean; the row body asserts zero failures and zero cold
+    compiles itself."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_net_serve(rows, n=4000, d=16, n_lists=32, n_probes=8, k=5,
+                         thread_ladder=(1, 2), per_thread=25, max_batch=16,
+                         n_eval=64, ncl=32)
+    row = rows[-1]
+    assert row["name"] == "net_serve_100k" and "error" not in row, rows
+    assert row["recall_wire"] == row["recall_inproc"], row
+    assert row["cache_misses"] == 0, row
+    assert row["qps"] > 0 and row["qps_inproc"] > 0, row
+    assert set(row["qps_by_threads"]) == {"inproc", "wire"}, row
+    assert {"wire_total_ms", "queue_ms", "flush_ms"} == \
+        set(row["p99_decomp"]), row
+
+
+def test_net_kill_worker_row():
+    """The --net-serve kill row (ISSUE 20 acceptance): a worker process
+    SIGKILLed under closed-loop wire load becomes strike→fence→failover
+    with ZERO failed queries and exact post-kill recall; the surviving
+    fleet reports zero cold compiles. The shrunk 2x2 mesh must come back
+    clean (the row body asserts the acceptance bits itself)."""
+    import pytest
+
+    pytest.importorskip("jax")
+    import bench
+
+    rows = []
+    bench._row_net_kill_worker(rows, n=2000, d=16, k=5, threads=3,
+                               duration_s=2.5, kill_after_s=1.0,
+                               n_eval=32, max_batch=16)
+    row = rows[-1]
+    assert row["name"] == "net_kill_worker_100k" and "error" not in row, rows
+    assert row["failed"] == 0, row
+    assert row["failovers"] >= 1, row
+    assert row["recall_after_kill"] == 1.0, row
+    assert row["fleet"]["cache_misses"] == 0, row
+    assert row["healthy_by_shard"] == [1, 2], row
+
+
+def test_net_serve_flag_runs_only_the_net_rows(monkeypatch):
+    """`bench.py --net-serve` is the front-door iteration loop: setup +
+    the two net rows, nothing else."""
+    import bench
+
+    calls = []
+    monkeypatch.setattr(bench, "_setup", lambda rows: calls.append("setup"))
+    monkeypatch.setattr(
+        bench, "_row_net_serve",
+        lambda rows: rows.append({"name": "net_serve_100k",
+                                  "recall_wire": 1.0}))
+    monkeypatch.setattr(
+        bench, "_row_net_kill_worker",
+        lambda rows: rows.append({"name": "net_kill_worker_100k",
+                                  "failed": 0}))
+    monkeypatch.setattr(bench, "_run",
+                        lambda rows: calls.append("run"))  # must NOT fire
+    try:
+        rc = bench.main(["--net-serve"])
+        assert rc == 0 and calls == ["setup"]
+        names = {r.get("name") for r in bench._STATE["rows"]}
+        assert {"net_serve_100k", "net_kill_worker_100k"} <= names
+    finally:
+        bench._STATE["rows"].clear()
